@@ -1,0 +1,18 @@
+"""TPU-native framework for recurrent factor models on firm×month panels.
+
+A from-scratch JAX/XLA rebuild of the capabilities of ``lakshaykc/lfm_quant``
+(TensorFlow/CUDA lineage — see SURVEY.md; the reference checkout was empty
+when surveyed, so parity is defined against the functional spec in
+SURVEY.md §2–§7 / BASELINE.json, not against reference file:line cites).
+
+Layer map (SURVEY.md §2):
+  data/      — L1 panel store + L2 windowing pipeline
+  models/    — L3 MLP / LSTM / GRU / transformer factor models
+  ops/       — losses (masked MSE, cross-sectional rank-IC) and metrics
+  train/     — L4 training loop, checkpointing, L5 multi-seed ensembles
+  parallel/  — device mesh + shardings (DP over dates, ensemble over seeds)
+  backtest/  — forecasts → monthly ranks → portfolio → CAGR/Sharpe/IC
+  utils/     — profiling/throughput harness, misc
+"""
+
+__version__ = "0.1.0"

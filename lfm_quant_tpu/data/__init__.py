@@ -1,0 +1,24 @@
+"""Data layer: panel store (L1) and windowing pipeline (L2)."""
+
+from lfm_quant_tpu.data.panel import Panel, PanelSplits, load_panel, synthetic_panel
+from lfm_quant_tpu.data.windows import (
+    DateBatchSampler,
+    WindowIndex,
+    anchor_index,
+    device_panel,
+    gather_targets,
+    gather_windows,
+)
+
+__all__ = [
+    "Panel",
+    "PanelSplits",
+    "load_panel",
+    "synthetic_panel",
+    "WindowIndex",
+    "anchor_index",
+    "DateBatchSampler",
+    "device_panel",
+    "gather_targets",
+    "gather_windows",
+]

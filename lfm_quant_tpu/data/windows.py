@@ -1,0 +1,248 @@
+"""Windowing pipeline (L2): the TPU-native `BatchGenerator`/`Dataset`.
+
+Functional parity target: the reference's ``BatchGenerator`` / ``Dataset``
+pipeline (SURVEY.md §3; BASELINE.json:5) — panel → per-firm lookback windows
+(60 months in the ladder configs), padding/masking of short histories,
+seed-keyed shuffling.
+
+TPU-first redesign (SURVEY.md §8, "hard parts"): instead of the reference's
+host-side streaming of materialized ``(B, T, F)`` batches into the device,
+the *panel lives in HBM* and every batch is a cheap on-device gather:
+
+  host side   — tiny int32 index batches (which firm, which anchor month),
+                computed by a numpy sampler keyed by seed;
+  device side — ``gather_windows`` turns index batches into ``(…, W, F)``
+                windows with validity masks, inside the jitted train step.
+
+This makes input bandwidth per step O(batch indices) instead of
+O(batch × window × features), which is the single decision SURVEY.md §8
+flags as likely dominating the throughput target.
+
+Batch layout: ``[D, Bf]`` — D distinct *months* per batch, Bf firms sampled
+per month.  The cross-sectional rank-IC loss (BASELINE.json:9) normalizes
+and ranks *within a month*; keeping each month's firms contiguous in one row
+(and, under data parallelism, on one shard — see parallel/mesh.py) means the
+loss never needs a cross-shard collective.  This is the sharding subtlety
+SURVEY.md §8 step 8 says to decide up front.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lfm_quant_tpu.data.panel import Panel
+
+
+@dataclasses.dataclass
+class WindowIndex:
+    """One batch of window anchors, in the [D, Bf] per-date layout.
+
+    Attributes:
+      firm_idx: ``[D, Bf]`` int32 — panel row of each sampled firm.
+      time_idx: ``[D]`` int32 — anchor month (column) shared by each row.
+      weight:   ``[D, Bf]`` float32 — 1.0 for real samples, 0.0 for padding
+        (dates whose eligible cross-section is smaller than Bf are padded by
+        repetition with zero weight so shapes stay static).
+    """
+
+    firm_idx: np.ndarray
+    time_idx: np.ndarray
+    weight: np.ndarray
+
+
+def rolling_valid_count(valid: np.ndarray, window: int) -> np.ndarray:
+    """``[N, T]`` count of valid months in the trailing window ``[t-W+1, t]``."""
+    csum = np.cumsum(valid.astype(np.int64), axis=1)
+    total = np.empty_like(csum)
+    total[:, :window] = csum[:, :window]
+    total[:, window:] = csum[:, window:] - csum[:, :-window]
+    return total
+
+
+def anchor_index(
+    panel: Panel, window: int, min_valid_months: Optional[int] = None
+) -> np.ndarray:
+    """Eligibility matrix of window anchors.
+
+    Returns ``[N, T]`` bool: True where (firm, t) is a trainable anchor —
+    the target is observable at t and the lookback window ``[t-W+1, t]``
+    contains at least ``min_valid_months`` valid months (default: W//2, so
+    young firms with ≥30 months of history still train, padded+masked, which
+    matches the reference's padding of short histories per SURVEY.md §3).
+    """
+    if min_valid_months is None:
+        min_valid_months = max(1, window // 2)
+    total = rolling_valid_count(panel.valid, window)
+    return panel.target_valid & (total >= min_valid_months) & panel.valid
+
+
+class DateBatchSampler:
+    """Seed-keyed sampler emitting ``WindowIndex`` batches in [D, Bf] layout.
+
+    Every epoch: shuffle eligible dates, and for each date sample ``firms_per
+    _date`` eligible firms without replacement (re-shuffled per epoch). All
+    randomness flows from ``seed`` so ensemble members get independent data
+    orders via distinct seeds (SURVEY.md §8 "per-seed PRNG folds").
+    """
+
+    def __init__(
+        self,
+        panel: Panel,
+        window: int,
+        dates_per_batch: int,
+        firms_per_date: int,
+        seed: int = 0,
+        min_valid_months: Optional[int] = None,
+        min_cross_section: int = 8,
+    ):
+        self.window = window
+        self.dates_per_batch = dates_per_batch
+        self.firms_per_date = firms_per_date
+        self.seed = seed
+        eligible = anchor_index(panel, window, min_valid_months)
+        counts = eligible.sum(axis=0)
+        self._dates = np.nonzero(counts >= min_cross_section)[0].astype(np.int32)
+        if self._dates.size == 0:
+            raise ValueError(
+                "no date has an eligible cross-section >= "
+                f"{min_cross_section}; panel too small for window={window}"
+            )
+        if self._dates.size < dates_per_batch:
+            raise ValueError(
+                f"dates_per_batch={dates_per_batch} exceeds the "
+                f"{self._dates.size} eligible dates in the panel"
+            )
+        # Eval sweeps cover every date with any eligible anchor — the
+        # min_cross_section filter is a *training* concern only.
+        self._all_dates = np.nonzero(counts > 0)[0].astype(np.int32)
+        self._firms_by_date = {
+            int(t): np.nonzero(eligible[:, t])[0].astype(np.int32)
+            for t in self._all_dates
+        }
+        self._epoch = 0
+
+    @property
+    def n_eligible_dates(self) -> int:
+        return int(self._dates.size)
+
+    def batches_per_epoch(self) -> int:
+        return self._dates.size // self.dates_per_batch
+
+    def epoch(self, epoch: Optional[int] = None) -> Iterator[WindowIndex]:
+        """Iterate one epoch of batches. Deterministic in (seed, epoch)."""
+        if epoch is None:
+            epoch = self._epoch
+            self._epoch += 1
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch, 0xF1B])
+        )
+        order = rng.permutation(self._dates)
+        nb = self.batches_per_epoch()
+        bf = self.firms_per_date
+        for b in range(nb):
+            dsel = order[b * self.dates_per_batch : (b + 1) * self.dates_per_batch]
+            if dsel.size < self.dates_per_batch:
+                break
+            firm_idx = np.empty((dsel.size, bf), dtype=np.int32)
+            weight = np.ones((dsel.size, bf), dtype=np.float32)
+            for j, t in enumerate(dsel):
+                pool = self._firms_by_date[int(t)]
+                if pool.size >= bf:
+                    firm_idx[j] = rng.choice(pool, size=bf, replace=False)
+                else:
+                    firm_idx[j, : pool.size] = rng.permutation(pool)
+                    firm_idx[j, pool.size :] = pool[
+                        rng.integers(0, pool.size, size=bf - pool.size)
+                    ]
+                    weight[j, pool.size :] = 0.0
+            yield WindowIndex(
+                firm_idx=firm_idx,
+                time_idx=dsel.astype(np.int32),
+                weight=weight,
+            )
+
+    def full_cross_sections(self) -> Iterator[WindowIndex]:
+        """Deterministic sweep over every eligible (date, firm) pair, for
+        eval/inference: each batch is one date's full cross-section padded to
+        the max cross-section size. Covers ALL dates with eligible anchors,
+        including those below the training ``min_cross_section`` filter."""
+        bf = max(self._firms_by_date[int(t)].size for t in self._all_dates)
+        for t in self._all_dates:
+            pool = self._firms_by_date[int(t)]
+            firm_idx = np.empty((1, bf), dtype=np.int32)
+            weight = np.zeros((1, bf), dtype=np.float32)
+            firm_idx[0, : pool.size] = pool
+            firm_idx[0, pool.size :] = pool[-1] if pool.size else 0
+            weight[0, : pool.size] = 1.0
+            yield WindowIndex(
+                firm_idx=firm_idx,
+                time_idx=np.asarray([t], dtype=np.int32),
+                weight=weight,
+            )
+
+
+def device_panel(panel: Panel, sharding=None) -> dict:
+    """Pin the panel's jit-visible arrays in device memory (HBM).
+
+    Returns a dict pytree {features, valid, targets, target_valid} of
+    ``jnp`` arrays.  With a ``NamedSharding`` the panel is replicated or
+    sharded as requested; by default it lands on the local device.  The
+    returns/dates stay host-side — only the training path needs HBM.
+    """
+    put = (lambda x: jax.device_put(x, sharding)) if sharding is not None else jnp.asarray
+    return {
+        "features": put(panel.features),
+        "valid": put(panel.valid),
+        "targets": put(panel.targets),
+        "target_valid": put(panel.target_valid),
+    }
+
+
+def gather_windows(
+    features: jax.Array,
+    valid: jax.Array,
+    firm_idx: jax.Array,
+    time_idx: jax.Array,
+    window: int,
+):
+    """On-device window gather: index batch → (windows, mask).
+
+    Args:
+      features: ``[N, T, F]`` panel features (device-resident).
+      valid:    ``[N, T]`` bool validity.
+      firm_idx: ``[..., ]`` int32 firm rows (any batch shape).
+      time_idx: int32 anchor months, broadcastable to ``firm_idx``'s shape
+        (the [D, Bf] layout passes ``[D]`` against ``[D, Bf]``).
+      window:   static lookback length W.
+
+    Returns:
+      ``(x, m)`` where ``x`` is ``[..., W, F]`` float windows (invalid steps
+      zero-filled) and ``m`` is ``[..., W]`` bool step-validity. The gather
+      lowers to a single XLA gather — no host transfer, no [N, W, F]
+      intermediate.
+    """
+    if time_idx.ndim == firm_idx.ndim - 1:
+        time_idx = time_idx[..., None]
+    time_b = jnp.broadcast_to(time_idx, firm_idx.shape)
+    offs = jnp.arange(window, dtype=jnp.int32) - (window - 1)
+    t = time_b[..., None] + offs  # [..., W]
+    in_range = t >= 0
+    t_c = jnp.clip(t, 0, features.shape[1] - 1)
+    f = firm_idx[..., None]  # [..., 1] broadcast over W
+    x = features[f, t_c]  # [..., W, F]
+    m = valid[f, t_c] & in_range  # [..., W]
+    x = jnp.where(m[..., None], x, jnp.zeros((), dtype=features.dtype))
+    return x, m
+
+
+def gather_targets(targets: jax.Array, firm_idx: jax.Array, time_idx: jax.Array):
+    """Gather anchor-month targets for an index batch → ``firm_idx``-shaped."""
+    if time_idx.ndim == firm_idx.ndim - 1:
+        time_idx = time_idx[..., None]
+    t = jnp.broadcast_to(time_idx, firm_idx.shape)
+    return targets[firm_idx, t]

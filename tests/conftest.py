@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Must run before jax initializes (SURVEY.md §5: distributed code paths are
+exercised in CI via ``--xla_force_host_platform_device_count=8`` with no
+pod). Keeping tests on CPU also keeps them hermetic w.r.t. the single real
+TPU chip used for benchmarking.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_debug_nans", False)
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.device_count()} ({jax.default_backend()})"
