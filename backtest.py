@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Backtest entry point — parity with the reference's ``backtest.py``
+(SURVEY.md §4.3; BASELINE.json:5): trained checkpoint(s) → forecasts for
+every eligible firm×month → monthly cross-sectional ranks → top-quantile
+portfolio → CAGR/Sharpe/IC report.
+
+Usage:
+    python backtest.py --run-dir runs/c1_mlp_toy/seed0
+    python backtest.py --run-dir runs/c5_lstm_ensemble64/ensemble \\
+        --mode mean_minus_std --quantile 0.2 --long-short
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--run-dir", required=True,
+                    help="run directory written by train.py")
+    ap.add_argument("--split", default="test", choices=["test", "val", "train"],
+                    help="which date split to simulate on")
+    ap.add_argument("--quantile", type=float, default=0.1)
+    ap.add_argument("--long-short", action="store_true")
+    ap.add_argument("--costs-bps", type=float, default=0.0)
+    ap.add_argument("--mode", default="mean",
+                    choices=["mean", "mean_minus_std"],
+                    help="ensemble aggregation (ensemble run dirs only)")
+    ap.add_argument("--risk-lambda", type=float, default=1.0)
+    ap.add_argument("--json-out", default=None,
+                    help="write the full report JSON here")
+    args = ap.parse_args(argv)
+
+    from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
+
+    is_ensemble = os.path.exists(os.path.join(args.run_dir, "ensemble.flag"))
+    if is_ensemble:
+        from lfm_quant_tpu.train.ensemble import load_ensemble
+        ens, splits = load_ensemble(args.run_dir)
+        stacked, stacked_valid = ens.predict(args.split)
+        forecast, fc_valid = aggregate_ensemble(
+            stacked, stacked_valid, args.mode, args.risk_lambda)
+    else:
+        from lfm_quant_tpu.train.loop import load_trainer
+        trainer, splits = load_trainer(args.run_dir)
+        forecast, fc_valid = trainer.predict(args.split)
+
+    report = run_backtest(
+        forecast, fc_valid, splits.panel,
+        quantile=args.quantile, long_short=args.long_short,
+        costs_bps=args.costs_bps,
+    )
+    print(report.summary())
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            fh.write(report.to_json())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

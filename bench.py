@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Benchmark harness: ladder config 2 (single-seed LSTM, 20 features,
+60-month lookback — BASELINE.json:8) training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: firm-months/sec/chip (BASELINE.json:2) — firm-month observations
+consumed by training per second (real windows × window length; padded
+slots excluded). No reference number exists (BASELINE.json:13
+"published": {} — see BASELINE.md), so vs_baseline is reported against the
+round-1 recorded value in BENCH_BASELINE.json when present, else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from lfm_quant_tpu.config import get_preset
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.train import Trainer
+
+    cfg = get_preset("c2")
+    # Bench panel: full config-2 feature/window geometry, trimmed months so
+    # panel generation isn't the bench bottleneck.
+    d = cfg.data
+    panel = synthetic_panel(
+        n_firms=d.n_firms, n_months=240, n_features=d.n_features,
+        horizon=d.horizon, seed=0,
+    )
+    splits = PanelSplits.by_date(panel, 198601, 198801)
+    trainer = Trainer(cfg, splits)
+    state = trainer.init_state()
+
+    # One epoch of index batches, scanned inside a single jit dispatch
+    # (lax.scan over steps) — per-dispatch latency is excluded by design,
+    # and the final float() readback forces a true device sync (on the
+    # tunneled axon device, block_until_ready alone does not wait).
+    b = trainer.train_sampler.stacked_epoch(0)
+    k = min(30, b.firm_idx.shape[0])
+    import dataclasses as _dc
+    b = _dc.replace(b, firm_idx=b.firm_idx[:k], time_idx=b.time_idx[:k],
+                    weight=b.weight[:k])
+    fi, ti, w = trainer._batch_args(b, train=True, steps=True)
+    fm = float(b.weight.sum()) * trainer.window
+
+    # Warmup: compile + one full pass.
+    _, ms = trainer._jit_multi_step(state, trainer.dev, fi, ti, w)
+    _ = float(ms["loss"][-1])
+
+    reps = 3
+    t0 = time.perf_counter()
+    st = state
+    for _ in range(reps):
+        st, ms = trainer._jit_multi_step(st, trainer.dev, fi, ti, w)
+    _ = float(ms["loss"][-1])
+    dt = (time.perf_counter() - t0) / reps
+
+    value = fm / dt
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(base_path):
+        try:
+            with open(base_path) as fh:
+                base = json.load(fh).get("value", 0.0)
+            if base > 0:
+                vs = value / base
+        except Exception:
+            pass
+    print(json.dumps({
+        "metric": "train_throughput_c2_lstm",
+        "value": round(value, 1),
+        "unit": "firm-months/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

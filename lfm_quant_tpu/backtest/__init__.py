@@ -1,0 +1,9 @@
+"""Backtest / evaluation layer: forecasts → portfolio → performance report."""
+
+from lfm_quant_tpu.backtest.engine import (
+    BacktestReport,
+    aggregate_ensemble,
+    run_backtest,
+)
+
+__all__ = ["BacktestReport", "run_backtest", "aggregate_ensemble"]

@@ -1,0 +1,173 @@
+"""Backtest engine — parity with the reference's ``backtest.py`` entry
+(SURVEY.md §3, §4.3; BASELINE.json:5): trained model(s) → forecasts →
+monthly cross-sectional ranks → top-quantile portfolio → CAGR/Sharpe/IC
+report. Lookahead-factor lineage: rank the cross-section each month by the
+forecast factor, hold the top quantile, rebalance monthly (SURVEY.md §1
+[BACKGROUND]).
+
+This is the cold evaluation path — plain numpy, runs on host. The hot
+forecast generation lives in Trainer.predict_panel / the ensemble trainer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+import numpy as np
+
+from lfm_quant_tpu.data.panel import Panel
+
+
+@dataclasses.dataclass
+class BacktestReport:
+    """Monthly-rebalance portfolio simulation results.
+
+    All rates are per-month unless suffixed _ann; months with no tradeable
+    universe are skipped (recorded in ``n_skipped_months``).
+    """
+
+    cagr: float
+    sharpe_ann: float
+    mean_ic: float           # per-month Spearman(forecast, realized target)
+    mean_ret_ic: float       # per-month Spearman(forecast, forward return)
+    max_drawdown: float
+    turnover: float          # mean fraction of portfolio replaced per month
+    hit_rate: float          # fraction of months with positive return
+    n_months: int
+    n_skipped_months: int
+    monthly_returns: np.ndarray  # [T_used]
+    monthly_ic: np.ndarray       # [T_used]
+    dates: np.ndarray            # [T_used] YYYYMM of formation months
+
+    def to_json(self) -> str:
+        d = dataclasses.asdict(self)
+        for k in ("monthly_returns", "monthly_ic", "dates"):
+            d[k] = np.asarray(d[k]).tolist()
+        return json.dumps(d, indent=2)
+
+    def summary(self) -> str:
+        return (
+            f"CAGR {self.cagr:+.2%} | Sharpe {self.sharpe_ann:.2f} | "
+            f"IC {self.mean_ic:+.3f} | retIC {self.mean_ret_ic:+.3f} | "
+            f"maxDD {self.max_drawdown:.2%} | turnover {self.turnover:.2f} | "
+            f"months {self.n_months}"
+        )
+
+
+def _spearman(a: np.ndarray, b: np.ndarray) -> float:
+    ra = np.argsort(np.argsort(a)).astype(np.float64)
+    rb = np.argsort(np.argsort(b)).astype(np.float64)
+    ra -= ra.mean()
+    rb -= rb.mean()
+    denom = np.sqrt((ra * ra).sum() * (rb * rb).sum())
+    return float((ra * rb).sum() / denom) if denom > 0 else 0.0
+
+
+def aggregate_ensemble(
+    forecasts: np.ndarray,
+    fc_valid: np.ndarray,
+    mode: str = "mean",
+    risk_lambda: float = 1.0,
+):
+    """Combine stacked per-seed forecasts [S, N, T] → ([N, T], [N, T] valid).
+
+    ``mode``:
+      * "mean"           — ensemble average (the reference's multi-seed
+        aggregation, SURVEY.md §4.3).
+      * "mean_minus_std" — uncertainty-penalized score ``mean − λ·std``
+        (uncertainty-aware LFM lineage, SURVEY.md §1 [BACKGROUND]).
+    ``fc_valid`` may be [N, T] (shared) or [S, N, T] (per-seed; a cell is
+    valid if ALL seeds predicted it).
+    """
+    if forecasts.ndim != 3:
+        raise ValueError(f"expected [S, N, T] forecasts, got {forecasts.shape}")
+    valid = fc_valid.all(axis=0) if fc_valid.ndim == 3 else fc_valid
+    mean = forecasts.mean(axis=0)
+    if mode == "mean":
+        score = mean
+    elif mode == "mean_minus_std":
+        score = mean - risk_lambda * forecasts.std(axis=0)
+    else:
+        raise ValueError(f"unknown ensemble mode {mode!r}")
+    return np.where(valid, score, 0.0).astype(np.float32), valid
+
+
+def run_backtest(
+    forecast: np.ndarray,
+    fc_valid: np.ndarray,
+    panel: Panel,
+    quantile: float = 0.1,
+    long_short: bool = False,
+    min_universe: int = 20,
+    periods_per_year: int = 12,
+    rf_monthly: float = 0.0,
+    costs_bps: float = 0.0,
+) -> BacktestReport:
+    """Monthly-rebalance quantile portfolio simulation.
+
+    Each month t with ≥ ``min_universe`` forecastable firms: rank the
+    cross-section by ``forecast[:, t]``, go long the top ``quantile``
+    (equal-weight); with ``long_short`` also short the bottom quantile.
+    The position earns the forward 1-month return ``panel.returns[:, t]``.
+    ``costs_bps`` charges that many basis points on each month's turnover.
+    """
+    n, t_len = forecast.shape
+    if panel.returns.shape != (n, t_len):
+        raise ValueError("forecast and panel shapes disagree")
+    rets, ics, ret_ics, dates, turns = [], [], [], [], []
+    prev_long: Optional[set] = None
+    skipped = 0
+    for t in range(t_len):
+        uni = np.nonzero(fc_valid[:, t] & panel.valid[:, t])[0]
+        if uni.size < min_universe:
+            skipped += 1
+            continue
+        f = forecast[uni, t]
+        k = max(1, int(round(uni.size * quantile)))
+        order = np.argsort(f)
+        long_ix = uni[order[-k:]]
+        port_ret = float(panel.returns[long_ix, t].mean())
+        if long_short:
+            short_ix = uni[order[:k]]
+            port_ret -= float(panel.returns[short_ix, t].mean())
+        cur = set(long_ix.tolist())
+        if prev_long is not None:
+            turn = 1.0 - len(cur & prev_long) / max(len(cur), 1)
+            turns.append(turn)
+            port_ret -= costs_bps * 1e-4 * turn
+        prev_long = cur
+        rets.append(port_ret)
+        ics.append(_spearman(f, panel.targets[uni, t])
+                   if panel.target_valid[uni, t].any() else 0.0)
+        ret_ics.append(_spearman(f, panel.returns[uni, t]))
+        dates.append(int(panel.dates[t]))
+
+    if not rets:
+        raise ValueError(
+            f"no month had a universe of >= {min_universe} forecastable firms"
+        )
+    r = np.asarray(rets, np.float64)
+    excess = r - rf_monthly
+    growth = np.cumprod(1.0 + r)
+    years = len(r) / periods_per_year
+    cagr = float(growth[-1] ** (1.0 / years) - 1.0) if years > 0 else 0.0
+    vol = float(excess.std(ddof=1)) if len(r) > 1 else 0.0
+    sharpe = float(excess.mean() / vol * np.sqrt(periods_per_year)) if vol > 0 else 0.0
+    peak = np.maximum.accumulate(growth)
+    max_dd = float(((growth - peak) / peak).min())
+    return BacktestReport(
+        cagr=cagr,
+        sharpe_ann=sharpe,
+        mean_ic=float(np.mean(ics)),
+        mean_ret_ic=float(np.mean(ret_ics)),
+        max_drawdown=max_dd,
+        turnover=float(np.mean(turns)) if turns else 0.0,
+        hit_rate=float((r > 0).mean()),
+        n_months=len(r),
+        n_skipped_months=skipped,
+        monthly_returns=r.astype(np.float32),
+        monthly_ic=np.asarray(ics, np.float32),
+        dates=np.asarray(dates, np.int32),
+    )

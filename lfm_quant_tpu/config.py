@@ -1,0 +1,161 @@
+"""Experiment configuration system.
+
+Parity target: the reference's config/flag system selecting model type,
+features, window, universe, seeds (SURVEY.md §3 [INFERRED]; the five ladder
+configs at BASELINE.json:6-12 are checked in as named presets below).
+
+Plain dataclasses, JSON-loadable, no external config framework.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Panel + windowing parameters (L1/L2)."""
+
+    n_firms: int = 1000
+    n_months: int = 240
+    n_features: int = 5
+    start_yyyymm: int = 197001
+    window: int = 60
+    horizon: int = 12
+    dates_per_batch: int = 8
+    firms_per_date: int = 128
+    min_valid_months: Optional[int] = None
+    # Date splits (YYYYMM): computed from panel range when None.
+    train_end: Optional[int] = None
+    val_end: Optional[int] = None
+    panel_path: Optional[str] = None  # load a real panel instead of synthetic
+    panel_seed: int = 0
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Model selection + hyperparameters (L3)."""
+
+    kind: str = "mlp"  # mlp | lstm | gru | transformer
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    bf16: bool = False
+    heteroscedastic: bool = False
+
+
+@dataclasses.dataclass
+class OptimConfig:
+    """Optimizer / schedule / stopping (L4)."""
+
+    lr: float = 1e-3
+    weight_decay: float = 1e-4
+    warmup_steps: int = 100
+    grad_clip: float = 1.0
+    epochs: int = 20
+    early_stop_patience: int = 5  # epochs without val improvement
+    loss: str = "mse"  # mse | huber | rank_ic | nll
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Top-level experiment config (L5/L6)."""
+
+    name: str = "default"
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
+    seed: int = 0
+    n_seeds: int = 1  # >1 → ensemble (vmapped replicas)
+    n_data_shards: int = 1  # data-parallel axis size
+    out_dir: str = "runs"
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @staticmethod
+    def from_json(text: str) -> "RunConfig":
+        raw = json.loads(text)
+        return RunConfig(
+            name=raw.get("name", "default"),
+            data=DataConfig(**raw.get("data", {})),
+            model=ModelConfig(**raw.get("model", {})),
+            optim=OptimConfig(**raw.get("optim", {})),
+            seed=raw.get("seed", 0),
+            n_seeds=raw.get("n_seeds", 1),
+            n_data_shards=raw.get("n_data_shards", 1),
+            out_dir=raw.get("out_dir", "runs"),
+        )
+
+
+def _ladder() -> Dict[str, RunConfig]:
+    """The five capability-ladder presets (BASELINE.json:6-12)."""
+    c1 = RunConfig(
+        name="c1_mlp_toy",
+        data=DataConfig(n_firms=1000, n_months=240, n_features=5, window=12,
+                        dates_per_batch=8, firms_per_date=128),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (64, 32)}),
+        optim=OptimConfig(lr=1e-3, epochs=20, loss="mse"),
+    )
+    c2 = RunConfig(
+        name="c2_lstm_single",
+        data=DataConfig(n_firms=4000, n_months=480, n_features=20, window=60,
+                        dates_per_batch=8, firms_per_date=256),
+        model=ModelConfig(kind="lstm", kwargs={"hidden": 128}, bf16=True),
+        optim=OptimConfig(lr=1e-3, epochs=30, loss="mse"),
+    )
+    c3 = RunConfig(
+        name="c3_gru_rank_ic",
+        data=DataConfig(n_firms=8000, n_months=480, n_features=20, window=60,
+                        dates_per_batch=8, firms_per_date=512),
+        model=ModelConfig(kind="gru", kwargs={"hidden": 128}, bf16=True),
+        optim=OptimConfig(lr=5e-4, epochs=30, loss="rank_ic"),
+        n_data_shards=8,
+    )
+    c4 = RunConfig(
+        name="c4_transformer_bf16",
+        data=DataConfig(n_firms=8000, n_months=480, n_features=20, window=60,
+                        dates_per_batch=16, firms_per_date=512),
+        model=ModelConfig(kind="transformer",
+                          kwargs={"dim": 64, "depth": 2, "heads": 4}, bf16=True),
+        optim=OptimConfig(lr=5e-4, epochs=30, loss="mse"),
+        n_data_shards=16,
+    )
+    c5 = RunConfig(
+        name="c5_lstm_ensemble64",
+        data=DataConfig(n_firms=8000, n_months=660, n_features=20, window=60,
+                        start_yyyymm=197001, dates_per_batch=8,
+                        firms_per_date=256),
+        model=ModelConfig(kind="lstm", kwargs={"hidden": 128}, bf16=True),
+        optim=OptimConfig(lr=1e-3, epochs=30, loss="mse"),
+        n_seeds=64,
+        n_data_shards=1,
+    )
+    return {c.name: c for c in (c1, c2, c3, c4, c5)}
+
+
+PRESETS: Dict[str, RunConfig] = _ladder()
+# Short aliases: c1..c5.
+PRESETS.update({f"c{i}": cfg for i, cfg in enumerate(_ladder().values(), 1)})
+
+
+def get_preset(name: str) -> RunConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown preset {name!r}; available: "
+            f"{sorted(k for k in PRESETS if not k.startswith('c') or len(k) > 2)}"
+        ) from None
+
+
+def model_kwargs(cfg: RunConfig) -> Tuple[str, Dict[str, Any]]:
+    """Resolve ModelConfig into build_model(kind, **kwargs) arguments."""
+    import jax.numpy as jnp
+
+    kw = dict(cfg.model.kwargs)
+    if cfg.model.bf16:
+        kw["dtype"] = jnp.bfloat16
+    if cfg.model.heteroscedastic or cfg.optim.loss == "nll":
+        kw["heteroscedastic"] = True
+    return cfg.model.kind, kw

@@ -310,19 +310,70 @@ def synthetic_panel(
 
 @dataclasses.dataclass
 class PanelSplits:
-    """Date-based train/val/test split of one panel (no firm leakage —
-    the same firms appear in all splits, separated in time, which is the
-    standard protocol for this workload)."""
+    """Date-based train/val/test split over ONE shared panel.
 
-    train: Panel
-    val: Panel
-    test: Panel
+    The panel is NOT sliced: every split is an *anchor-month index range*
+    over the same arrays. This is deliberate and load-bearing:
+
+    * Lookback windows anchored early in the val/test range legitimately
+      reach back into earlier months for history — slicing the panel would
+      amputate that context (a 60-month window has no eligible anchors in
+      a 24-month slice at all).
+    * One panel ⇒ one HBM-resident copy serving train, eval and inference.
+    * No leakage: what separates the splits is the *anchor* (and therefore
+      target/trade) months, not feature visibility — same firms, separated
+      in time, the standard protocol for this workload.  Training anchors
+      are additionally embargoed ``horizon`` months before ``train_end`` so
+      no training target is realized inside the validation period.
+    """
+
+    panel: Panel
+    train_end_idx: int  # first month index NOT in train
+    val_end_idx: int    # first month index NOT in val
 
     @staticmethod
     def by_date(panel: Panel, train_end: int, val_end: int) -> "PanelSplits":
-        d0, d1 = int(panel.dates[0]), int(panel.dates[-1]) + 1
-        return PanelSplits(
-            train=panel.date_slice(d0, train_end),
-            val=panel.date_slice(train_end, val_end),
-            test=panel.date_slice(val_end, d1),
-        )
+        """Boundaries as YYYYMM: train = [start, train_end), val =
+        [train_end, val_end), test = [val_end, end). Each period must be
+        longer than ``panel.horizon`` so the target-embargoed anchor ranges
+        (see ``train_range``/``val_range``) stay non-empty."""
+        dates = panel.dates
+        t_idx = int(np.searchsorted(dates, train_end))
+        v_idx = int(np.searchsorted(dates, val_end))
+        if not (0 < t_idx < v_idx < panel.n_months):
+            raise ValueError(
+                f"split boundaries ({train_end}, {val_end}) must fall "
+                f"strictly inside the panel's date range "
+                f"[{dates[0]}, {dates[-1]}] in order")
+        h = panel.horizon
+        if t_idx <= h or v_idx - t_idx <= h:
+            raise ValueError(
+                f"train period ({t_idx} months) and val period "
+                f"({v_idx - t_idx} months) must each exceed the target "
+                f"horizon ({h} months) for embargoed anchors to exist")
+        return PanelSplits(panel=panel, train_end_idx=t_idx, val_end_idx=v_idx)
+
+    @property
+    def train_range(self) -> tuple:
+        """Anchor range for training, embargoed so targets (realized
+        ``horizon`` months after the anchor) stay inside the train period."""
+        return (0, self.train_end_idx - self.panel.horizon)
+
+    @property
+    def val_range(self) -> tuple:
+        """Anchor range for validation, embargoed at the far end so no val
+        target is realized inside the test period (early stopping selects
+        on val IC — without this embargo, checkpoint selection would be
+        conditioned on test-period outcomes)."""
+        return (self.train_end_idx, self.val_end_idx - self.panel.horizon)
+
+    @property
+    def test_range(self) -> tuple:
+        return (self.val_end_idx, self.panel.n_months)
+
+    def range_of(self, split: str) -> tuple:
+        try:
+            return {"train": self.train_range, "val": self.val_range,
+                    "test": self.test_range}[split]
+        except KeyError:
+            raise ValueError(f"unknown split {split!r}") from None

@@ -99,12 +99,25 @@ class DateBatchSampler:
         seed: int = 0,
         min_valid_months: Optional[int] = None,
         min_cross_section: int = 8,
+        date_range: Optional[tuple] = None,
     ):
+        """``date_range=(lo, hi)`` restricts ANCHOR months to panel column
+        indices [lo, hi) — the split mechanism (PanelSplits): windows still
+        reach back before ``lo`` for history; only anchors are bounded."""
         self.window = window
         self.dates_per_batch = dates_per_batch
         self.firms_per_date = firms_per_date
         self.seed = seed
         eligible = anchor_index(panel, window, min_valid_months)
+        if date_range is not None:
+            lo, hi = date_range
+            if not (0 <= lo < hi <= panel.n_months):
+                raise ValueError(
+                    f"date_range {date_range} outside panel months "
+                    f"[0, {panel.n_months})")
+            bounded = np.zeros_like(eligible)
+            bounded[:, lo:hi] = eligible[:, lo:hi]
+            eligible = bounded
         counts = eligible.sum(axis=0)
         self._dates = np.nonzero(counts >= min_cross_section)[0].astype(np.int32)
         if self._dates.size == 0:
@@ -165,6 +178,29 @@ class DateBatchSampler:
                 time_idx=dsel.astype(np.int32),
                 weight=weight,
             )
+
+    def stacked_cross_sections(self) -> WindowIndex:
+        """All eligible cross-sections as ONE [M, bf] index batch (M eval
+        months × padded max cross-section) — a single device dispatch for
+        the whole eval/inference sweep, instead of one per month (dispatch
+        latency dominates small ops on remote/tunneled devices)."""
+        batches = list(self.full_cross_sections())
+        return WindowIndex(
+            firm_idx=np.concatenate([b.firm_idx for b in batches], axis=0),
+            time_idx=np.concatenate([b.time_idx for b in batches], axis=0),
+            weight=np.concatenate([b.weight for b in batches], axis=0),
+        )
+
+    def stacked_epoch(self, epoch: Optional[int] = None) -> WindowIndex:
+        """One whole epoch as a [K, D, Bf] index stack for the in-jit
+        multi-step scan (lax.scan over training steps: one dispatch per
+        epoch)."""
+        batches = list(self.epoch(epoch))
+        return WindowIndex(
+            firm_idx=np.stack([b.firm_idx for b in batches]),
+            time_idx=np.stack([b.time_idx for b in batches]),
+            weight=np.stack([b.weight for b in batches]),
+        )
 
     def full_cross_sections(self) -> Iterator[WindowIndex]:
         """Deterministic sweep over every eligible (date, firm) pair, for

@@ -1,0 +1,51 @@
+"""Factor models (L3): MLP, LSTM, GRU, transformer encoder.
+
+Parity targets: the reference's ``mlp_model`` and ``rnn_model`` (LSTM/GRU)
+plus the transformer-encoder ladder config (SURVEY.md §3; BASELINE.json:5,10).
+All models share one calling convention:
+
+    pred = model.apply({'params': p}, x, m)        # point forecast
+    x: [B, W, F] float windows, m: [B, W] bool step-validity
+    pred: [B] float32 — or (mean, log_var) [B] pairs when
+    ``heteroscedastic=True`` (uncertainty head, lineage of the 2020
+    uncertainty-aware LFM paper — SURVEY.md §1 [BACKGROUND]).
+
+TPU-first choices: recurrent cells use one fused gate matmul per step
+(MXU-shaped), driven by ``lax.scan`` over the window axis (prescribed at
+BASELINE.json:5); compute dtype is bf16 with fp32 params and fp32 head
+output; masking holds carried state through invalid months so ragged
+histories never contaminate the forecast.
+"""
+
+from lfm_quant_tpu.models.mlp import MLPModel
+from lfm_quant_tpu.models.rnn import GRUModel, LSTMModel, RNNModel
+from lfm_quant_tpu.models.transformer import TransformerModel
+
+MODEL_REGISTRY = {
+    "mlp": MLPModel,
+    "lstm": LSTMModel,
+    "gru": GRUModel,
+    "transformer": TransformerModel,
+}
+
+
+def build_model(kind: str, **kwargs):
+    """Construct a model by registry name (config system entry point)."""
+    try:
+        cls = MODEL_REGISTRY[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown model kind {kind!r}; available: {sorted(MODEL_REGISTRY)}"
+        ) from None
+    return cls(**kwargs)
+
+
+__all__ = [
+    "MLPModel",
+    "LSTMModel",
+    "GRUModel",
+    "RNNModel",
+    "TransformerModel",
+    "MODEL_REGISTRY",
+    "build_model",
+]

@@ -1,0 +1,43 @@
+"""Shared forecast head: features → point forecast or (mean, log_var)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ForecastHead(nn.Module):
+    """MLP head over pooled features.
+
+    Emits fp32 regardless of compute dtype — losses and the backtest always
+    see full precision (bf16 in the trunk, fp32 at the boundary is the
+    standard TPU mixed-precision recipe).
+    """
+
+    hidden: Sequence[int] = ()
+    heteroscedastic: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, z):
+        for i, h in enumerate(self.hidden):
+            z = nn.Dense(h, dtype=self.dtype, name=f"hidden_{i}")(z)
+            z = nn.gelu(z)
+        out_dim = 2 if self.heteroscedastic else 1
+        y = nn.Dense(out_dim, dtype=jnp.float32, name="out")(z)
+        y = y.astype(jnp.float32)
+        if self.heteroscedastic:
+            mean, log_var = y[..., 0], y[..., 1]
+            # Soft-clamp log-variance for stable NLL early in training.
+            log_var = 8.0 * jnp.tanh(log_var / 8.0)
+            return mean, log_var
+        return y[..., 0]
+
+
+def masked_mean_pool(z, m):
+    """Mean over valid steps: z [..., W, D], m [..., W] → [..., D]."""
+    m = m.astype(z.dtype)[..., None]
+    denom = jnp.maximum(m.sum(axis=-2), 1.0)
+    return (z * m).sum(axis=-2) / denom

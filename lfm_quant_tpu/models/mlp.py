@@ -1,0 +1,47 @@
+"""MLP factor model — parity with the reference's ``mlp_model``
+(SURVEY.md §3; BASELINE.json:5,7 — the 5-feature toy-panel config runs here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from lfm_quant_tpu.models.heads import ForecastHead
+
+
+class MLPModel(nn.Module):
+    """Feed-forward model over the flattened (masked) lookback window.
+
+    The window is flattened to ``W*F`` inputs (masked steps contribute
+    zeros) plus one scalar valid-fraction input so the net can distinguish
+    "zero feature" from "missing month". With ``window_input=False`` only
+    the anchor month's features are used — the classic cross-sectional MLP.
+    """
+
+    hidden: Sequence[int] = (64, 32)
+    window_input: bool = True
+    heteroscedastic: bool = False
+    dropout: float = 0.0
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, m, deterministic: bool = True):
+        x = x.astype(self.dtype) if self.dtype is not None else x
+        mf = m.astype(x.dtype)
+        if self.window_input:
+            z = (x * mf[..., None]).reshape(*x.shape[:-2], -1)
+            frac = mf.mean(axis=-1, keepdims=True)
+            z = jnp.concatenate([z, frac], axis=-1)
+        else:
+            z = x[..., -1, :] * mf[..., -1:]
+        for i, h in enumerate(self.hidden):
+            z = nn.Dense(h, dtype=self.dtype, name=f"dense_{i}")(z)
+            z = nn.gelu(z)
+            if self.dropout > 0.0:
+                z = nn.Dropout(self.dropout, deterministic=deterministic)(z)
+        return ForecastHead(
+            heteroscedastic=self.heteroscedastic, dtype=self.dtype, name="head"
+        )(z)

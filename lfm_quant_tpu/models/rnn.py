@@ -1,0 +1,136 @@
+"""Recurrent factor models — parity with the reference's ``rnn_model``
+(LSTM/GRU variants; SURVEY.md §3, BASELINE.json:5,8,9).
+
+TPU-first design:
+
+* Each cell step is ONE fused gate matmul ``[x, h] @ W → 4H (LSTM) / 3H
+  (GRU)`` so the MXU sees a single large GEMM per step instead of eight
+  small ones (the GRU needs a second small matmul for the candidate because
+  the reset gate is applied to ``h`` *before* its projection).
+* The time axis is driven by ``lax.scan`` via ``nn.scan`` (prescribed at
+  BASELINE.json:5) — compiled once, no Python unrolling.
+* Masking: invalid months HOLD the carried state (h, c unchanged), so a
+  firm's forecast is a function of its valid history only; with left-padded
+  short histories the initial zero state simply persists until the first
+  valid month.
+* bf16 compute / fp32 params: pass ``dtype=jnp.bfloat16``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from lfm_quant_tpu.models.heads import ForecastHead
+
+
+class LSTMCellFused(nn.Module):
+    """LSTM cell with a single fused ifgo matmul and state-hold masking.
+
+    carry = (h, c), input = (x_t, m_t) where m_t carries a trailing
+    singleton dim ([..., 1]) so the scan treats x and m uniformly on axis -2;
+    returns h_t as the per-step output.
+    """
+
+    hidden: int
+    forget_bias: float = 1.0
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, carry, xm):
+        h, c = carry
+        x, m = xm
+        x = x.astype(h.dtype)
+        z = jnp.concatenate([x, h], axis=-1)
+        gates = nn.Dense(4 * self.hidden, dtype=self.dtype, name="ifgo")(z)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = nn.sigmoid(f + self.forget_bias) * c + nn.sigmoid(i) * jnp.tanh(g)
+        h_new = nn.sigmoid(o) * jnp.tanh(c_new)
+        keep = m.astype(h.dtype)
+        h = keep * h_new + (1.0 - keep) * h
+        c = keep * c_new + (1.0 - keep) * c
+        return (h, c), h
+
+
+class GRUCellFused(nn.Module):
+    """GRU cell: fused z/r matmul + candidate matmul, state-hold masking."""
+
+    hidden: int
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, carry, xm):
+        (h,) = carry
+        x, m = xm
+        x = x.astype(h.dtype)
+        zin = jnp.concatenate([x, h], axis=-1)
+        zr = nn.Dense(2 * self.hidden, dtype=self.dtype, name="zr")(zin)
+        z, r = jnp.split(zr, 2, axis=-1)
+        z, r = nn.sigmoid(z), nn.sigmoid(r)
+        cand_in = jnp.concatenate([x, r * h], axis=-1)
+        n = jnp.tanh(nn.Dense(self.hidden, dtype=self.dtype, name="cand")(cand_in))
+        h_new = (1.0 - z) * n + z * h
+        keep = m.astype(h.dtype)
+        h = keep * h_new + (1.0 - keep) * h
+        return (h,), h
+
+
+_CELLS = {"lstm": LSTMCellFused, "gru": GRUCellFused}
+
+
+class RNNModel(nn.Module):
+    """Stacked masked RNN over the lookback window → forecast head.
+
+    ``cell``: "lstm" | "gru".  Input projection lifts F → hidden once so
+    every scan step's fused matmul is (hidden + hidden) × gates — a square,
+    MXU-friendly shape even when F is tiny (5–20 in the ladder configs).
+    """
+
+    cell: str = "lstm"
+    hidden: int = 128
+    layers: int = 1
+    head_hidden: Sequence[int] = ()
+    heteroscedastic: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, m, deterministic: bool = True):
+        if self.cell not in _CELLS:
+            raise ValueError(f"cell must be one of {sorted(_CELLS)}")
+        compute_dtype = self.dtype or jnp.float32
+        batch_shape = x.shape[:-2]
+        h = nn.Dense(self.hidden, dtype=self.dtype, name="embed")(
+            x.astype(compute_dtype)
+        )
+        mexp = m[..., None].astype(compute_dtype)  # [..., W, 1]: scan axis -2
+        zeros = jnp.zeros((*batch_shape, self.hidden), compute_dtype)
+        cell_cls = _CELLS[self.cell]
+        for layer in range(self.layers):
+            scan = nn.scan(
+                cell_cls,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                in_axes=-2,   # time axis of (x, m) inputs
+                out_axes=-2,
+            )(hidden=self.hidden, dtype=self.dtype, name=f"{self.cell}_{layer}")
+            carry = (zeros, zeros) if self.cell == "lstm" else (zeros,)
+            _, h = scan(carry, (h, mexp))
+        # Masked steps held state, so the last step's output is the state at
+        # the last *valid* month.
+        z = h[..., -1, :]
+        return ForecastHead(
+            hidden=self.head_hidden,
+            heteroscedastic=self.heteroscedastic,
+            dtype=self.dtype,
+            name="head",
+        )(z)
+
+
+def LSTMModel(**kw) -> RNNModel:
+    return RNNModel(cell="lstm", **kw)
+
+
+def GRUModel(**kw) -> RNNModel:
+    return RNNModel(cell="gru", **kw)

@@ -1,0 +1,90 @@
+"""Transformer-encoder factor model — parity with ladder config 4
+(BASELINE.json:10 — "Transformer encoder over fundamentals (replace RNN),
+mixed bf16").
+
+Each month of the lookback window is a token. At W=60 tokens full attention
+is trivially cheap (SURVEY.md §6: no sequence parallelism needed at this
+scale), so the encoder is a standard pre-norm stack; key-padding masking
+handles ragged histories. bf16 compute / fp32 params via ``dtype``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from lfm_quant_tpu.models.heads import ForecastHead, masked_mean_pool
+
+
+class EncoderBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, z, attn_mask, deterministic: bool = True):
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(z)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads,
+            dtype=self.dtype,
+            dropout_rate=self.dropout,
+            deterministic=deterministic,
+            name="attn",
+        )(y, y, mask=attn_mask)
+        z = z + y
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(z)
+        y = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype, name="mlp_out")(y)
+        return z + y
+
+
+class TransformerModel(nn.Module):
+    """Pre-norm encoder over month-tokens with masked mean pooling."""
+
+    dim: int = 64
+    depth: int = 2
+    heads: int = 4
+    mlp_ratio: int = 4
+    head_hidden: Sequence[int] = ()
+    heteroscedastic: bool = False
+    dropout: float = 0.0
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, m, deterministic: bool = True):
+        w = x.shape[-2]
+        compute_dtype = self.dtype or jnp.float32
+        z = nn.Dense(self.dim, dtype=self.dtype, name="embed")(
+            x.astype(compute_dtype)
+        )
+        pos = self.param(
+            "pos_emb", nn.initializers.normal(0.02), (w, self.dim), jnp.float32
+        )
+        z = z + pos.astype(z.dtype)
+        # Key-padding mask: queries may be anything (pooling ignores invalid
+        # outputs); keys must be valid months. [..., 1(heads), W(q), W(kv)]
+        attn_mask = jnp.broadcast_to(
+            m[..., None, None, :], (*m.shape[:-1], 1, w, w)
+        )
+        for i in range(self.depth):
+            z = EncoderBlock(
+                dim=self.dim,
+                heads=self.heads,
+                mlp_ratio=self.mlp_ratio,
+                dropout=self.dropout,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(z, attn_mask, deterministic=deterministic)
+        z = nn.LayerNorm(dtype=self.dtype, name="ln_f")(z)
+        pooled = masked_mean_pool(z, m)
+        return ForecastHead(
+            hidden=self.head_hidden,
+            heteroscedastic=self.heteroscedastic,
+            dtype=self.dtype,
+            name="head",
+        )(pooled)

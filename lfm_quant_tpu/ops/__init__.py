@@ -1,0 +1,20 @@
+"""Losses and metrics: masked regression losses, cross-sectional rank-IC."""
+
+from lfm_quant_tpu.ops.losses import (
+    gaussian_nll,
+    masked_huber,
+    masked_mse,
+    rank_ic_loss,
+    soft_rank,
+)
+from lfm_quant_tpu.ops.metrics import pearson_ic, spearman_ic
+
+__all__ = [
+    "masked_mse",
+    "masked_huber",
+    "gaussian_nll",
+    "soft_rank",
+    "rank_ic_loss",
+    "pearson_ic",
+    "spearman_ic",
+]

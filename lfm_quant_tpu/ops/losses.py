@@ -1,0 +1,93 @@
+"""Training losses (L3/L4 boundary).
+
+Parity targets: the reference's forecast regression loss and the
+**cross-sectional rank-IC loss** of ladder config 3 (SURVEY.md §3;
+BASELINE.json:9 — "GRU + cross-sectional rank-IC loss"). The reference code
+was unobservable (SURVEY.md §0); the rank-IC construction below is the
+standard differentiable Spearman surrogate: pairwise-sigmoid soft ranks,
+then a Pearson correlation of soft ranks per month.
+
+Shape convention: all cross-sectional losses take ``[D, Bf]`` arrays — D
+months per batch, Bf firms per month (the windowing layout from
+data/windows.py). Ranking happens along the LAST axis only, so under data
+parallelism the D axis shards freely and no collective is needed
+(SURVEY.md §8 step 8's correctness trap).
+
+Weights: ``w`` is the sampler's padding weight (0 for padded slots); every
+loss treats w=0 entries as absent.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _weighted_mean(x, w, axis=None):
+    w = w.astype(x.dtype)
+    return (x * w).sum(axis=axis) / jnp.maximum(w.sum(axis=axis), 1e-12)
+
+
+def masked_mse(pred, target, w):
+    """Weighted mean squared error over real (w>0) samples → scalar."""
+    return _weighted_mean((pred - target) ** 2, w)
+
+
+def masked_huber(pred, target, w, delta: float = 1.0):
+    """Weighted Huber loss → scalar (robust to fundamental outliers)."""
+    err = jnp.abs(pred - target)
+    quad = jnp.minimum(err, delta)
+    lin = err - quad
+    return _weighted_mean(0.5 * quad**2 + delta * lin, w)
+
+
+def gaussian_nll(mean, log_var, target, w):
+    """Heteroscedastic Gaussian NLL for the uncertainty head → scalar.
+
+    (Uncertainty-aware LFM lineage — SURVEY.md §1 [BACKGROUND].)
+    """
+    nll = 0.5 * (log_var + (target - mean) ** 2 * jnp.exp(-log_var))
+    return _weighted_mean(nll, w)
+
+
+def soft_rank(x, w, temperature: float = 1.0):
+    """Differentiable ranks along the last axis.
+
+    ``soft_rank[i] = sum_j w_j * sigmoid((x_i - x_j) / temperature)`` — a
+    smooth count of how many (real) elements each element exceeds. As
+    temperature → 0 this approaches the hard rank (in [0, n-1] up to the
+    0.5 self-comparison). O(n²) pairwise — one [D, Bf, Bf] batched outer
+    difference, which XLA maps straight onto the MXU/VPU; at monthly
+    cross-section sizes (≤ a few thousand firms) this is cheap.
+
+    Padded entries (w=0) neither receive meaningful ranks nor influence
+    real ranks.
+    """
+    diff = (x[..., :, None] - x[..., None, :]) / temperature
+    p = jnp.where(w[..., None, :] > 0, jnp.asarray(1.0, x.dtype) /
+                  (1.0 + jnp.exp(-diff)), 0.0)
+    return p.sum(axis=-1)
+
+
+def _center_corr(a, b, w):
+    """Weighted Pearson correlation along the last axis → [...] (per month)."""
+    wa = _weighted_mean(a, w, axis=-1)[..., None]
+    wb = _weighted_mean(b, w, axis=-1)[..., None]
+    ac, bc = (a - wa) * w, (b - wb) * w
+    cov = (ac * bc).sum(axis=-1)
+    va = (ac * ac).sum(axis=-1)
+    vb = (bc * bc).sum(axis=-1)
+    return cov / jnp.maximum(jnp.sqrt(va * vb), 1e-8)
+
+
+def rank_ic_loss(pred, target, w, temperature: float = 0.5):
+    """Negative mean per-month soft Spearman correlation → scalar.
+
+    ``pred, target, w: [D, Bf]``; ranks are computed within each month (last
+    axis), correlations averaged over months, negated so lower is better.
+    Target ranks use a small temperature (closer to hard ranks) since no
+    gradient flows through the target side.
+    """
+    pr = soft_rank(pred, w, temperature)
+    tr = soft_rank(target, w, temperature=1e-3)
+    ic = _center_corr(pr, tr, w.astype(pred.dtype))
+    return -ic.mean()

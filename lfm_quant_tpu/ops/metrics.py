@@ -1,0 +1,55 @@
+"""Evaluation metrics: exact per-month information coefficients.
+
+Used by validation/early-stopping (L4) and the backtest report (SURVEY.md
+§4.3). Exact (non-differentiable) counterparts of ops/losses.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _masked_pearson(a, b, w):
+    w = w.astype(a.dtype)
+    denom = jnp.maximum(w.sum(axis=-1, keepdims=True), 1e-12)
+    ma = (a * w).sum(axis=-1, keepdims=True) / denom
+    mb = (b * w).sum(axis=-1, keepdims=True) / denom
+    ac, bc = (a - ma) * w, (b - mb) * w
+    cov = (ac * bc).sum(axis=-1)
+    va = (ac * ac).sum(axis=-1)
+    vb = (bc * bc).sum(axis=-1)
+    return cov / jnp.maximum(jnp.sqrt(va * vb), 1e-8)
+
+
+def pearson_ic(pred, target, w):
+    """Per-month Pearson IC along the last axis → [...] correlations."""
+    return _masked_pearson(pred, target, w)
+
+
+def _hard_ranks(x, w):
+    """Exact competition-free average ranks of real entries along last axis.
+
+    Padded entries are pushed to +inf so they occupy the top rank slots and
+    never perturb real entries' ranks; their rank values are meaningless and
+    must be masked out by the caller (we multiply by w downstream). Ties get
+    distinct ranks in index order (midranks are not needed for continuous
+    forecasts; exact tie handling documented in tests).
+    """
+    big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
+    xs = jnp.where(w > 0, x, big)
+    order = jnp.argsort(xs, axis=-1)
+    arange = jnp.broadcast_to(jnp.arange(x.shape[-1], dtype=x.dtype), xs.shape)
+    # scatter: rank[order[i]] = i
+    return jnp.put_along_axis(
+        jnp.zeros_like(xs), order, arange, axis=-1, inplace=False
+    )
+
+
+def spearman_ic(pred, target, w):
+    """Exact per-month Spearman rank correlation along the last axis.
+
+    Matches ``scipy.stats.spearmanr`` on untied data (validated in tests).
+    """
+    pr = _hard_ranks(pred, w)
+    tr = _hard_ranks(target, w)
+    return _masked_pearson(pr, tr, w)

@@ -1,0 +1,19 @@
+"""Parallelism layer: device mesh + shardings (seed × data axes)."""
+
+from lfm_quant_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+    seed_sharding,
+    shard_batch,
+    state_sharding,
+)
+
+__all__ = [
+    "make_mesh",
+    "replicated",
+    "batch_sharding",
+    "seed_sharding",
+    "state_sharding",
+    "shard_batch",
+]

@@ -1,0 +1,112 @@
+"""Device mesh + shardings — the distributed communication backend
+(SURVEY.md §6 "Distributed communication backend").
+
+Parity target: the reference's ``tf.distribute`` data parallelism with
+NCCL all-reduce underneath (SURVEY.md §3; BASELINE.json:5). The TPU-native
+equivalent is *declarative*: build a ``jax.sharding.Mesh`` over the slice,
+annotate array shardings, and let XLA insert the collectives (psum over
+ICI for gradient reduction, DCN-transparent across hosts). There is no
+NCCL/MPI layer to port — XLA *is* the backend (prescribed verbatim at
+BASELINE.json:5: "vmap'd replicas … gradients reduced via lax.psum over
+ICI instead of per-GPU tf.distribute").
+
+Axes:
+  * ``seed`` — ensemble replicas (the reference's signature scaling axis:
+    64 seeds on a v5e-64, one per chip).
+  * ``data`` — batch data parallelism. Batches use the [D dates, Bf firms]
+    layout and shard the DATE axis only, so each month's cross-section is
+    shard-local and the rank-IC loss needs no collective (SURVEY.md §8
+    step 8's correctness requirement).
+
+Multi-host: the same code runs under ``jax.distributed.initialize()`` —
+``jax.devices()`` then spans all hosts and XLA routes collectives over
+ICI within a slice and DCN across slices. Nothing here is host-count
+aware by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEED_AXIS = "seed"
+DATA_AXIS = "data"
+
+
+def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (seed × data) mesh over the available devices.
+
+    ``n_data`` defaults to ``len(devices) // n_seed``. A 1×1 mesh on a
+    single device is valid and keeps the code path uniform.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        if len(devices) % n_seed:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by n_seed={n_seed}")
+        n_data = len(devices) // n_seed
+    need = n_seed * n_data
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {n_seed}x{n_data} needs {need} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(n_seed, n_data)
+    return Mesh(grid, (SEED_AXIS, DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (the device-resident panel, scalars)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, with_seed_axis: bool = False) -> NamedSharding:
+    """Sharding for index batches.
+
+    [D, Bf] → dates over 'data', firms unsharded (cross-sections stay
+    whole). With a leading seed axis: [S, D, Bf] → ('seed', 'data', None).
+    """
+    spec = P(SEED_AXIS, DATA_AXIS) if with_seed_axis else P(DATA_AXIS)
+    return NamedSharding(mesh, spec)
+
+
+def seed_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for seed-stacked pytree leaves: leading axis over 'seed'."""
+    return NamedSharding(mesh, P(SEED_AXIS))
+
+
+def state_sharding(mesh: Mesh, state: Any, stacked: bool) -> Any:
+    """A sharding pytree matching ``state``.
+
+    ``stacked=True``: every array leaf gets its LEADING axis sharded over
+    'seed' (ensemble-stacked states); scalars (rank 0) replicate.
+    ``stacked=False``: fully replicated (plain DP).
+    """
+    def leaf_sharding(x):
+        if stacked and getattr(x, "ndim", 0) >= 1:
+            return seed_sharding(mesh)
+        return replicated(mesh)
+
+    return jax.tree.map(leaf_sharding, state)
+
+
+def shard_batch(mesh: Mesh, arrays: Sequence[jax.Array],
+                with_seed_axis: bool = False, steps_axis: bool = False):
+    """device_put a (firm_idx, time_idx, weight) batch with date-axis
+    sharding. time_idx has no firm axis, so its spec drops the last dim.
+    ``steps_axis`` prefixes an unsharded leading K axis (the in-jit
+    multi-step stack scanned by lax.scan)."""
+    lead = (None,) if steps_axis else ()
+    if with_seed_axis:
+        spec = P(*lead, SEED_AXIS, DATA_AXIS)
+    else:
+        spec = P(*lead, DATA_AXIS)
+    firm_idx, time_idx, weight = arrays
+    return (
+        jax.device_put(firm_idx, NamedSharding(mesh, spec)),
+        jax.device_put(time_idx, NamedSharding(mesh, spec)),
+        jax.device_put(weight, NamedSharding(mesh, spec)),
+    )

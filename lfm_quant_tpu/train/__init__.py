@@ -1,0 +1,6 @@
+"""Training layer (L4) + experiment orchestration (L5)."""
+
+from lfm_quant_tpu.train.checkpoint import CheckpointManager
+from lfm_quant_tpu.train.loop import TrainState, Trainer, make_loss_fn
+
+__all__ = ["Trainer", "TrainState", "make_loss_fn", "CheckpointManager"]

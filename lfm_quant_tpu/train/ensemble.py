@@ -1,0 +1,319 @@
+"""Multi-seed ensemble trainer (L5) — the reference's signature axis.
+
+Parity target: the reference's multi-seed ensemble trainer — N independent
+seeds of the same model, per-GPU replication under ``tf.distribute``
+(SURVEY.md §3; BASELINE.json:5,11 — 64-seed LSTM ensemble on the full
+panel). TPU-native re-expression (prescribed at BASELINE.json:5):
+
+* Seeds become a LEADING AXIS of one stacked train state:
+  ``params[s, ...], opt_state[s, ...]`` — built by ``vmap(init)`` over 64
+  PRNG keys, stepped by ``vmap``-ing the single-seed train step. One XLA
+  program trains all 64 members; on a v5e-64 the seed axis shards one
+  member per chip over the mesh's 'seed' axis, composing with the 'data'
+  axis for batch parallelism (SURVEY.md §8 step 9).
+* Ensemble diversity: each member gets BOTH its own init key and its own
+  data order — per-seed ``DateBatchSampler`` seeds (host-side index
+  generation is cheap; the [S, D, Bf] index stack is the only per-step
+  host→device traffic). This answers SURVEY.md §8's "hard part": per-seed
+  PRNG folds, not one shared iterator.
+* Checkpoints: ONE stacked PyTree (leading seed axis) via Orbax, so the
+  whole ensemble restores in a single read (SURVEY.md §6).
+* Early stopping on the ENSEMBLE-MEAN validation IC: members advance in
+  lock-step (that is what makes the wall-clock target meaningful);
+  per-member histories are logged for diagnosis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lfm_quant_tpu.config import RunConfig
+from lfm_quant_tpu.data.panel import Panel, PanelSplits
+from lfm_quant_tpu.data.windows import DateBatchSampler, device_panel
+from lfm_quant_tpu.parallel import (
+    make_mesh,
+    replicated,
+    shard_batch,
+    state_sharding,
+)
+from lfm_quant_tpu.train.checkpoint import CheckpointManager
+from lfm_quant_tpu.train.loop import TrainState, Trainer
+from lfm_quant_tpu.utils.logging import MetricsLogger
+from lfm_quant_tpu.utils.profiling import StepTimer
+
+
+class EnsembleTrainer:
+    """Trains ``cfg.n_seeds`` members as one vmapped, seed-sharded program."""
+
+    def __init__(self, cfg: RunConfig, splits: PanelSplits,
+                 run_dir: Optional[str] = None, echo: bool = False):
+        if cfg.n_seeds < 2:
+            raise ValueError("EnsembleTrainer needs n_seeds >= 2")
+        self.cfg = cfg
+        self.splits = splits
+        self.run_dir = run_dir
+        self.echo = echo
+        self.n_seeds = cfg.n_seeds
+
+        # The single-seed Trainer provides the model, loss, optimizer and
+        # jit-free step/forward impls that we vmap (build_data=False: we
+        # do the panel device transfer ourselves, under the ensemble mesh).
+        self.inner = Trainer(cfg, splits, run_dir=None, build_data=False)
+        self.window = self.inner.window
+
+        # Mesh: seed axis as large as divides both n_seeds and the device
+        # count; data axis from config when devices remain.
+        n_dev = jax.device_count()
+        n_seed_mesh = 1
+        for cand in range(min(self.n_seeds, n_dev), 0, -1):
+            if self.n_seeds % cand == 0 and n_dev % cand == 0:
+                n_seed_mesh = cand
+                break
+        n_data = max(1, min(cfg.n_data_shards, n_dev // n_seed_mesh))
+        self.mesh = (
+            make_mesh(n_seed_mesh, n_data)
+            if n_seed_mesh * n_data > 1 else None
+        )
+
+        # ONE HBM-resident panel serves the ensemble and the inner trainer
+        # (PanelSplits are anchor ranges over a shared panel, not slices).
+        self.dev = device_panel(
+            splits.panel, replicated(self.mesh) if self.mesh else None)
+        self.inner.dev = self.dev
+
+        d = cfg.data
+        self.samplers = [
+            DateBatchSampler(
+                splits.panel, d.window, d.dates_per_batch, d.firms_per_date,
+                seed=cfg.seed + s, min_valid_months=d.min_valid_months,
+                date_range=splits.train_range,
+            )
+            for s in range(self.n_seeds)
+        ]
+        self.val_sampler = self.inner.val_sampler
+
+        # vmap the single-seed impls over the stacked state + index batch;
+        # the device panel is broadcast (in_axes=None).
+        self._vstep = jax.vmap(self.inner._step_impl, in_axes=(0, None, 0, 0, 0))
+        self._jit_step = jax.jit(self._vstep)
+        self._jit_multi_step = jax.jit(self._multi_step_impl)
+        self._jit_forward = jax.jit(
+            jax.vmap(self.inner._forward_impl, in_axes=(0, None, None, None, None))
+        )
+
+    def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w):
+        """K vmapped ensemble steps in one dispatch: lax.scan over a
+        [K, S, D, Bf] index stack (see Trainer._multi_step_impl)."""
+        def body(st, batch):
+            return self._vstep(st, dev, *batch)
+
+        return jax.lax.scan(body, state, (fi, ti, w))
+
+    # ---- state -------------------------------------------------------
+
+    def init_state(self) -> TrainState:
+        keys = jax.random.split(jax.random.key(self.cfg.seed), self.n_seeds)
+        state = jax.vmap(self.inner.init_state)(keys)
+        if self.mesh is not None:
+            shardings = state_sharding(self.mesh, state, stacked=True)
+            state = jax.device_put(state, shardings)
+        return state
+
+    def _stacked_batch(self, iterators) -> Optional[Tuple]:
+        """Stack one [S, D, Bf] index batch from the per-seed samplers."""
+        batches = []
+        for it in iterators:
+            b = next(it, None)
+            if b is None:
+                return None
+            batches.append(b)
+        fi = np.stack([b.firm_idx for b in batches])
+        ti = np.stack([b.time_idx for b in batches])
+        w = np.stack([b.weight for b in batches])
+        arrays = (jnp.asarray(fi), jnp.asarray(ti), jnp.asarray(w))
+        if self.mesh is not None:
+            arrays = shard_batch(self.mesh, arrays, with_seed_axis=True)
+        return arrays
+
+    def _stacked_epoch(self, epoch: int) -> Tuple:
+        """One whole epoch for all seeds: [K, S, D, Bf] index stacks
+        (K = steps, truncated to the shortest member epoch)."""
+        per_seed = [s.stacked_epoch(epoch) for s in self.samplers]
+        k = min(b.firm_idx.shape[0] for b in per_seed)
+        fi = np.stack([b.firm_idx[:k] for b in per_seed], axis=1)
+        ti = np.stack([b.time_idx[:k] for b in per_seed], axis=1)
+        w = np.stack([b.weight[:k] for b in per_seed], axis=1)
+        arrays = (jnp.asarray(fi), jnp.asarray(ti), jnp.asarray(w))
+        if self.mesh is not None:
+            arrays = shard_batch(self.mesh, arrays, with_seed_axis=True,
+                                 steps_axis=True)
+        return arrays
+
+    # ---- training ----------------------------------------------------
+
+    def evaluate(self, params_stacked) -> Dict[str, Any]:
+        """Per-member and ensemble-mean val IC in ONE vmapped dispatch."""
+        b = self.val_sampler.stacked_cross_sections()
+        fi, ti, w = self.inner._batch_args(b)
+        _, ic, _ = self._jit_forward(params_stacked, self.dev, fi, ti, w)
+        ics = np.asarray(ic)  # [S, M]
+        counts = b.weight.sum(axis=1)  # [M]
+        per_seed = (ics * counts).sum(axis=1) / counts.sum()
+        return {"ic_per_seed": per_seed, "ic_mean": float(per_seed.mean()),
+                "ic_std": float(per_seed.std())}
+
+    def fit(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        if cfg.optim.epochs < 1:
+            raise ValueError(f"epochs must be >= 1, got {cfg.optim.epochs}")
+        state = self.init_state()
+        ckpt_dir = os.path.join(self.run_dir, "ckpt") if self.run_dir else None
+        ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
+        logger = MetricsLogger(self.run_dir, echo=self.echo)
+        timer = StepTimer()
+
+        best_ic, best_epoch, bad_epochs = -np.inf, -1, 0
+        history = []
+        for epoch in range(cfg.optim.epochs):
+            timer.start()
+            # Whole epoch × all seeds in one compiled dispatch.
+            fi, ti, w = self._stacked_epoch(epoch)
+            state, ms = self._jit_multi_step(state, self.dev, fi, ti, w)
+            fm = float(np.asarray(w).sum()) * self.window
+            mean_loss = float(ms["loss"].mean())  # sync point
+            timer.stop(firm_months=fm)
+
+            val = self.evaluate(state.params)
+            rec = logger.log(
+                int(np.asarray(state.step)[0]),
+                epoch=epoch,
+                train_loss=mean_loss,
+                val_ic=val["ic_mean"],
+                val_ic_std=val["ic_std"],
+                firm_months_per_sec=timer.throughput(),
+            )
+            history.append(rec)
+
+            if val["ic_mean"] > best_ic:
+                best_ic, best_epoch, bad_epochs = val["ic_mean"], epoch, 0
+                if ckpt:
+                    ckpt.save(int(np.asarray(state.step)[0]),
+                              state._asdict(), wait=True)
+            else:
+                bad_epochs += 1
+                if bad_epochs >= cfg.optim.early_stop_patience:
+                    break
+
+        if ckpt and best_epoch >= 0:
+            restored = ckpt.restore(state._asdict())
+            state = TrainState(**restored)
+            ckpt.close()
+        logger.close()
+        self.state = state
+        return {
+            "best_val_ic": best_ic,
+            "best_epoch": best_epoch,
+            "epochs_run": epoch + 1,
+            "n_seeds": self.n_seeds,
+            "firm_months_per_sec": timer.throughput(),
+            "history": history,
+        }
+
+    # ---- inference -----------------------------------------------------
+
+    def predict(self, split: str = "test") -> Tuple[np.ndarray, np.ndarray]:
+        """Stacked forecasts [S, N, T] + shared validity [N, T] over the
+        split's anchor range, for the backtest's ensemble aggregation
+        (SURVEY.md §4.3)."""
+        d = self.cfg.data
+        panel = self.splits.panel
+        sampler = DateBatchSampler(
+            panel, d.window, 1, d.firms_per_date, seed=0,
+            min_valid_months=d.min_valid_months, min_cross_section=1,
+            date_range=self.splits.range_of(split),
+        )
+        out = np.zeros((self.n_seeds, panel.n_firms, panel.n_months), np.float32)
+        out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
+        b = sampler.stacked_cross_sections()
+        fi, ti, w = self.inner._batch_args(b)
+        pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
+        pred = np.asarray(pred)  # [S, M, bf]
+        for j in range(pred.shape[1]):
+            t = int(b.time_idx[j])
+            real = b.weight[j] > 0
+            out[:, b.firm_idx[j][real], t] = pred[:, j, real]
+            out_valid[b.firm_idx[j][real], t] = True
+        return out, out_valid
+
+
+def run_ensemble_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
+                            echo: bool = False):
+    """Config → panel → splits → vmapped ensemble training → summary."""
+    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
+
+    d = cfg.data
+    if panel is None:
+        if d.panel_path:
+            panel = load_panel(d.panel_path)
+        else:
+            panel = synthetic_panel(
+                n_firms=d.n_firms, n_months=d.n_months,
+                n_features=d.n_features, start_yyyymm=d.start_yyyymm,
+                horizon=d.horizon, seed=d.panel_seed,
+            )
+    dates = panel.dates
+    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
+    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    splits = PanelSplits.by_date(panel, train_end, val_end)
+
+    run_dir = os.path.join(cfg.out_dir, cfg.name, "ensemble")
+    trainer = EnsembleTrainer(cfg, splits, run_dir=run_dir, echo=echo)
+    summary = trainer.fit()
+    summary["run_dir"] = run_dir
+    summary["config"] = dataclasses.asdict(cfg)
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "config.json"), "w") as fh:
+        fh.write(cfg.to_json())
+    with open(os.path.join(run_dir, "ensemble.flag"), "w") as fh:
+        fh.write("stacked-seed-axis checkpoint\n")
+    with open(os.path.join(run_dir, "summary.json"), "w") as fh:
+        json.dump({k: v for k, v in summary.items() if k != "history"}, fh,
+                  indent=2, default=str)
+    return summary, trainer, splits
+
+
+def load_ensemble(run_dir: str, panel: Optional[Panel] = None):
+    """Rebuild an EnsembleTrainer from a run dir + restore the stacked
+    checkpoint (backtest.py ensemble path)."""
+    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
+
+    with open(os.path.join(run_dir, "config.json")) as fh:
+        cfg = RunConfig.from_json(fh.read())
+    d = cfg.data
+    if panel is None:
+        if d.panel_path:
+            panel = load_panel(d.panel_path)
+        else:
+            panel = synthetic_panel(
+                n_firms=d.n_firms, n_months=d.n_months,
+                n_features=d.n_features, start_yyyymm=d.start_yyyymm,
+                horizon=d.horizon, seed=d.panel_seed,
+            )
+    dates = panel.dates
+    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
+    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    splits = PanelSplits.by_date(panel, train_end, val_end)
+    trainer = EnsembleTrainer(cfg, splits, run_dir=run_dir)
+    state = trainer.init_state()
+    ckpt = CheckpointManager(os.path.join(run_dir, "ckpt"))
+    restored = ckpt.restore(state._asdict())
+    ckpt.close()
+    trainer.state = TrainState(**restored)
+    return trainer, splits

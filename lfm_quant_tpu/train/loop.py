@@ -1,0 +1,386 @@
+"""Training loop (L4) + single-seed experiment runner.
+
+Parity target: the reference's session/`fit` training loop — optimizer, LR
+schedule, early stopping, checkpointing (SURVEY.md §3 "Training loop";
+call stack §4.1). TPU-native shape:
+
+* ONE jitted train step: on-device window gather (data/windows.py) →
+  flattened [D·Bf, W, F] forward (big MXU batches) → loss in [D, Bf]
+  per-month layout → grad → optax update. Nothing but int32 index
+  batches crosses host→device per step.
+* ``lax.scan`` drives the RNN window axis inside the model (BASELINE.json:5).
+* Early stopping on validation Spearman IC — the domain's canonical metric.
+* Orbax checkpoints via train/checkpoint.py; metrics to JSONL.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from lfm_quant_tpu.config import RunConfig, model_kwargs
+from lfm_quant_tpu.data.panel import Panel, PanelSplits
+from lfm_quant_tpu.data.windows import (
+    DateBatchSampler,
+    WindowIndex,
+    device_panel,
+    gather_targets,
+    gather_windows,
+)
+from lfm_quant_tpu.models import build_model
+from lfm_quant_tpu.parallel import make_mesh, replicated, shard_batch
+from lfm_quant_tpu.ops import (
+    gaussian_nll,
+    masked_huber,
+    masked_mse,
+    rank_ic_loss,
+    spearman_ic,
+)
+from lfm_quant_tpu.train.checkpoint import CheckpointManager
+from lfm_quant_tpu.utils.logging import MetricsLogger
+from lfm_quant_tpu.utils.profiling import StepTimer
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def make_loss_fn(name: str) -> Callable:
+    """Resolve a loss name to fn(outputs, targets, weights) → scalar.
+
+    ``outputs`` is the model's head output: [D, Bf] for point heads,
+    (mean, log_var) tuple for the heteroscedastic head (required by "nll").
+    """
+    if name == "mse":
+        return lambda out, y, w: masked_mse(out, y, w)
+    if name == "huber":
+        return lambda out, y, w: masked_huber(out, y, w)
+    if name == "rank_ic":
+        return lambda out, y, w: rank_ic_loss(out, y, w)
+    if name == "nll":
+        return lambda out, y, w: gaussian_nll(out[0], out[1], y, w)
+    raise ValueError(f"unknown loss {name!r}; use mse|huber|rank_ic|nll")
+
+
+def _point_forecast(out):
+    """Point forecast from either head type (mean for heteroscedastic)."""
+    return out[0] if isinstance(out, tuple) else out
+
+
+class Trainer:
+    """Single-seed trainer: fit on splits.train, early-stop on splits.val.
+
+    The ensemble trainer (train/ensemble.py) reuses the same jitted step
+    vmapped over a leading seed axis.
+    """
+
+    def __init__(self, cfg: RunConfig, splits: PanelSplits,
+                 run_dir: Optional[str] = None, echo: bool = False,
+                 build_data: bool = True):
+        """``build_data=False`` skips the panel device transfer (the large
+        allocation) — for wrappers (EnsembleTrainer) that provide their own
+        device panel. Samplers are always built: the LR schedule needs
+        batches_per_epoch, and the ensemble reuses val_sampler."""
+        self.cfg = cfg
+        self.splits = splits
+        self.run_dir = run_dir
+        self.echo = echo
+        d = cfg.data
+
+        kind, kwargs = model_kwargs(cfg)
+        self.model = build_model(kind, **kwargs)
+        self.loss_fn = make_loss_fn(cfg.optim.loss)
+        self.window = d.window
+
+        # Data-parallel mesh (SURVEY.md §8 step 8): shard the DATE axis of
+        # each batch so monthly cross-sections stay shard-local for rank-IC.
+        # Degrades gracefully to fewer devices than configured shards.
+        n_data = max(1, min(cfg.n_data_shards, jax.device_count()))
+        if d.dates_per_batch % n_data:
+            raise ValueError(
+                f"dates_per_batch={d.dates_per_batch} must be divisible by "
+                f"n_data_shards={n_data}")
+        self.mesh = make_mesh(1, n_data) if n_data > 1 else None
+
+        self.train_sampler = DateBatchSampler(
+            splits.panel, d.window, d.dates_per_batch, d.firms_per_date,
+            seed=cfg.seed, min_valid_months=d.min_valid_months,
+            date_range=splits.train_range,
+        )
+        self.val_sampler = DateBatchSampler(
+            splits.panel, d.window, 1, d.firms_per_date,
+            seed=cfg.seed, min_valid_months=d.min_valid_months,
+            min_cross_section=1, date_range=splits.val_range,
+        )
+        if build_data:
+            # ONE device-resident copy of the full panel serves training,
+            # eval and inference (PanelSplits are anchor ranges, not slices).
+            panel_sharding = replicated(self.mesh) if self.mesh else None
+            self.dev = device_panel(splits.panel, panel_sharding)
+        else:
+            self.dev = None
+
+        steps_per_epoch = self.train_sampler.batches_per_epoch()
+        total_steps = max(1, steps_per_epoch * cfg.optim.epochs)
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.optim.lr, min(cfg.optim.warmup_steps, total_steps // 2),
+            total_steps, end_value=cfg.optim.lr * 0.1,
+        )
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.optim.grad_clip),
+            optax.adamw(schedule, weight_decay=cfg.optim.weight_decay),
+        )
+
+        self._jit_step = jax.jit(self._step_impl)
+        self._jit_multi_step = jax.jit(self._multi_step_impl)
+        self._jit_forward = jax.jit(self._forward_impl)
+
+    # ---- jitted impls ------------------------------------------------
+
+    def _apply(self, params, x, m):
+        """Flatten [D, Bf] batch dims → one big MXU batch, reapply shape."""
+        lead = x.shape[:-2]
+        xf = x.reshape((-1,) + x.shape[-2:])
+        mf = m.reshape((-1,) + m.shape[-1:])
+        out = self.model.apply({"params": params}, xf, mf)
+        if isinstance(out, tuple):
+            return tuple(o.reshape(lead) for o in out)
+        return out.reshape(lead)
+
+    def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
+                   weight):
+        def loss_of(params):
+            x, m = gather_windows(
+                dev["features"], dev["valid"], firm_idx, time_idx, self.window
+            )
+            y = gather_targets(dev["targets"], firm_idx, time_idx)
+            out = self._apply(params, x, m)
+            return self.loss_fn(out, y, weight)
+
+        loss, grads = jax.value_and_grad(loss_of)(state.params)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        return TrainState(params, opt_state, state.step + 1), {
+            "loss": loss, "grad_norm": gnorm,
+        }
+
+    def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w):
+        """K training steps in ONE compiled dispatch: lax.scan over a
+        [K, D, Bf] index stack. Per-step dispatch latency (25–30 ms on a
+        tunneled device) would otherwise dwarf the ~ms of real compute per
+        step; scanning an epoch inside jit removes it entirely."""
+        def body(st, batch):
+            return self._step_impl(st, dev, *batch)
+
+        return jax.lax.scan(body, state, (fi, ti, w))
+
+    def _forward_impl(self, params, dev: dict, firm_idx, time_idx, weight):
+        """Eval forward: returns (pred [D,Bf], per-month IC [D], mse scalar)."""
+        x, m = gather_windows(
+            dev["features"], dev["valid"], firm_idx, time_idx, self.window
+        )
+        y = gather_targets(dev["targets"], firm_idx, time_idx)
+        pred = _point_forecast(self._apply(params, x, m))
+        ic = spearman_ic(pred, y, weight)
+        mse = masked_mse(pred, y, weight)
+        return pred, ic, mse
+
+    # ---- public API --------------------------------------------------
+
+    def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
+        if rng is None:
+            rng = jax.random.key(self.cfg.seed)
+        d = self.cfg.data
+        x = jnp.zeros((2, d.window, self.splits.panel.n_features), jnp.float32)
+        m = jnp.ones((2, d.window), bool)
+        params = self.model.init(rng, x, m)["params"]
+        return TrainState(params, self.tx.init(params), jnp.asarray(0))
+
+    def _batch_args(self, b: WindowIndex, train: bool = False,
+                    steps: bool = False):
+        arrays = (jnp.asarray(b.firm_idx), jnp.asarray(b.time_idx),
+                  jnp.asarray(b.weight))
+        if train and self.mesh is not None:
+            # Training batches shard dates across the mesh; XLA all-reduces
+            # the resulting gradients (replicated params) automatically.
+            return shard_batch(self.mesh, arrays, steps_axis=steps)
+        return arrays
+
+    def evaluate(self, state_params, sampler=None) -> Dict[str, float]:
+        """Validation sweep in ONE dispatch: all eval months stacked into a
+        single [M, bf] batch (rows = months, so per-month IC comes out of
+        the same [D, Bf] code path)."""
+        sampler = sampler or self.val_sampler
+        b = sampler.stacked_cross_sections()
+        fi, ti, w = self._batch_args(b)
+        _, ic, mse = self._jit_forward(state_params, self.dev, fi, ti, w)
+        counts = b.weight.sum(axis=1)
+        return {
+            "ic": float(np.average(np.asarray(ic), weights=counts)),
+            "mse": float(mse),
+            "n_months": int(counts.size),
+        }
+
+    def fit(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        if cfg.optim.epochs < 1:
+            raise ValueError(f"epochs must be >= 1, got {cfg.optim.epochs}")
+        state = self.init_state()
+        ckpt_dir = os.path.join(self.run_dir, "ckpt") if self.run_dir else None
+        ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
+        logger = MetricsLogger(self.run_dir, echo=self.echo)
+        timer = StepTimer()
+
+        best_ic, best_epoch, bad_epochs = -np.inf, -1, 0
+        history = []
+        for epoch in range(cfg.optim.epochs):
+            timer.start()
+            # Whole epoch in one compiled dispatch (lax.scan over steps).
+            b = self.train_sampler.stacked_epoch(epoch)
+            fi, ti, w = self._batch_args(b, train=True, steps=True)
+            state, ms = self._jit_multi_step(state, self.dev, fi, ti, w)
+            fm = float(b.weight.sum()) * self.window
+            # float() forces the device round-trip — the real sync point.
+            epoch_loss = float(ms["loss"].mean())
+            epoch_gnorm = float(ms["grad_norm"].mean())
+            timer.stop(firm_months=fm)
+
+            val = self.evaluate(state.params)
+            rec = logger.log(
+                int(state.step),
+                epoch=epoch,
+                train_loss=epoch_loss,
+                grad_norm=epoch_gnorm,
+                val_ic=val["ic"],
+                val_mse=val["mse"],
+                firm_months_per_sec=timer.throughput(),
+            )
+            history.append(rec)
+
+            if val["ic"] > best_ic:
+                best_ic, best_epoch, bad_epochs = val["ic"], epoch, 0
+                if ckpt:
+                    ckpt.save(int(state.step), state._asdict(), wait=True)
+            else:
+                bad_epochs += 1
+                if bad_epochs >= cfg.optim.early_stop_patience:
+                    break
+
+        # Restore best state for downstream prediction/backtest.
+        if ckpt and best_epoch >= 0:
+            restored = ckpt.restore(state._asdict())
+            state = TrainState(**restored)
+            ckpt.close()
+        logger.close()
+        self.state = state
+        return {
+            "best_val_ic": best_ic,
+            "best_epoch": best_epoch,
+            "epochs_run": epoch + 1,
+            "steps": int(state.step),
+            "firm_months_per_sec": timer.throughput(),
+            "history": history,
+        }
+
+    def predict(self, split: str = "test") -> Tuple[np.ndarray, np.ndarray]:
+        """Forecasts for every eligible anchor in a split's date range.
+
+        Returns (forecast [N, T] float32, pred_valid [N, T] bool) over the
+        FULL panel shape, with pred_valid True only inside the split range —
+        the backtest engine's input (SURVEY.md §4.3).
+        """
+        d = self.cfg.data
+        panel = self.splits.panel
+        sampler = DateBatchSampler(
+            panel, d.window, 1, d.firms_per_date, seed=0,
+            min_valid_months=d.min_valid_months, min_cross_section=1,
+            date_range=self.splits.range_of(split),
+        )
+        out = np.zeros((panel.n_firms, panel.n_months), np.float32)
+        out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
+        b = sampler.stacked_cross_sections()
+        fi, ti, w = self._batch_args(b)
+        pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
+        pred = np.asarray(pred)  # [M, bf]
+        for j in range(pred.shape[0]):
+            t = int(b.time_idx[j])
+            real = b.weight[j] > 0
+            out[b.firm_idx[j][real], t] = pred[j][real]
+            out_valid[b.firm_idx[j][real], t] = True
+        return out, out_valid
+
+
+def run_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
+                   echo: bool = False
+                   ) -> Tuple[Dict[str, Any], "Trainer", PanelSplits]:
+    """Config → panel → splits → train; returns (summary, trainer, splits)
+    — the train.py call stack, SURVEY.md §4.1."""
+    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
+
+    d = cfg.data
+    if panel is None:
+        if d.panel_path:
+            panel = load_panel(d.panel_path)
+        else:
+            panel = synthetic_panel(
+                n_firms=d.n_firms, n_months=d.n_months,
+                n_features=d.n_features, start_yyyymm=d.start_yyyymm,
+                horizon=d.horizon, seed=d.panel_seed,
+            )
+    dates = panel.dates
+    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
+    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    splits = PanelSplits.by_date(panel, train_end, val_end)
+
+    run_dir = os.path.join(cfg.out_dir, cfg.name, f"seed{cfg.seed}")
+    trainer = Trainer(cfg, splits, run_dir=run_dir, echo=echo)
+    summary = trainer.fit()
+    summary["run_dir"] = run_dir
+    summary["config"] = dataclasses.asdict(cfg)
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "config.json"), "w") as fh:
+        fh.write(cfg.to_json())
+    with open(os.path.join(run_dir, "summary.json"), "w") as fh:
+        json.dump({k: v for k, v in summary.items() if k != "history"}, fh,
+                  indent=2, default=str)
+    return summary, trainer, splits
+
+
+def load_trainer(run_dir: str, panel: Optional[Panel] = None):
+    """Rebuild a Trainer from a run directory and restore its best
+    checkpoint (the backtest.py call stack, SURVEY.md §4.3)."""
+    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
+
+    with open(os.path.join(run_dir, "config.json")) as fh:
+        cfg = RunConfig.from_json(fh.read())
+    d = cfg.data
+    if panel is None:
+        if d.panel_path:
+            panel = load_panel(d.panel_path)
+        else:
+            panel = synthetic_panel(
+                n_firms=d.n_firms, n_months=d.n_months,
+                n_features=d.n_features, start_yyyymm=d.start_yyyymm,
+                horizon=d.horizon, seed=d.panel_seed,
+            )
+    dates = panel.dates
+    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
+    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    splits = PanelSplits.by_date(panel, train_end, val_end)
+    trainer = Trainer(cfg, splits, run_dir=run_dir)
+    state = trainer.init_state()
+    ckpt = CheckpointManager(os.path.join(run_dir, "ckpt"))
+    restored = ckpt.restore(state._asdict())
+    ckpt.close()
+    trainer.state = TrainState(**restored)
+    return trainer, splits

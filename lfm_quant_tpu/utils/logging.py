@@ -1,0 +1,51 @@
+"""Structured run metrics: JSONL scalar stream per run directory.
+
+SURVEY.md §6 (metrics/observability): scalar metrics (loss, val IC,
+firm-months/sec) to JSONL + structured run dir per seed. TensorBoard is
+deliberately NOT in the loop — plain files keep the training path free of
+TF (BASELINE.json:5 "no GPU/TF in the loop").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+
+class MetricsLogger:
+    """Append-only JSONL metric stream (one dict per line, ts + step added)."""
+
+    def __init__(self, run_dir: Optional[str], filename: str = "metrics.jsonl",
+                 echo: bool = False):
+        self.run_dir = run_dir
+        self.echo = echo
+        self._fh = None
+        if run_dir is not None:
+            os.makedirs(run_dir, exist_ok=True)
+            self._fh = open(os.path.join(run_dir, filename), "a", buffering=1)
+
+    def log(self, step: int, **metrics: Any) -> Dict[str, Any]:
+        rec = {"ts": time.time(), "step": step}
+        rec.update(
+            {k: (float(v) if hasattr(v, "__float__") else v)
+             for k, v in metrics.items()}
+        )
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+        if self.echo:
+            shown = {k: v for k, v in rec.items() if k != "ts"}
+            print(json.dumps(shown))
+        return rec
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

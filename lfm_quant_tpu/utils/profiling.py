@@ -1,0 +1,74 @@
+"""Profiling / throughput harness (SURVEY.md §6: tracing subsystem).
+
+The reference's observability was at most TF timeline/TensorBoard
+[BACKGROUND]; the TPU-native equivalents are ``jax.profiler`` traces
+(Perfetto-viewable) and a ``block_until_ready`` wall-clock harness that
+reports **firm-months/sec/chip** — the driver's primary metric
+(BASELINE.json:2).
+
+Definition used throughout: one *firm-month* = one (firm, month) panel
+observation consumed by the model. A training step over ``B`` windows of
+length ``W`` with ``v`` real (non-padded) samples processes ``v × W``
+firm-months.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_context(log_dir: Optional[str]):
+    """Wrap a region in a jax.profiler trace when ``log_dir`` is set.
+
+    View with Perfetto (ui.perfetto.dev) or TensorBoard's profile plugin.
+    """
+    if log_dir:
+        jax.profiler.start_trace(log_dir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+    else:
+        yield
+
+
+class StepTimer:
+    """Wall-clock step timer with device-sync and firm-month accounting.
+
+    Usage:
+        t = StepTimer()
+        t.start()                      # syncs + stamps
+        out = step(...)                # async dispatch
+        t.stop(out, firm_months=n)     # block_until_ready + stamp
+        t.throughput()                 # firm-months/sec over recorded steps
+    """
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = None
+        self.seconds = 0.0
+        self.firm_months = 0.0
+        self.steps = 0
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, device_out=None, firm_months: float = 0.0):
+        if device_out is not None:
+            jax.block_until_ready(device_out)
+        dt = time.perf_counter() - self._t0
+        self.seconds += dt
+        self.firm_months += firm_months
+        self.steps += 1
+        return dt
+
+    def throughput(self) -> float:
+        """firm-months/sec over all recorded steps (0 if nothing timed)."""
+        return self.firm_months / self.seconds if self.seconds > 0 else 0.0

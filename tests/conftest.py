@@ -1,24 +1,21 @@
 """Test configuration: force an 8-device virtual CPU platform.
 
-Must run before jax initializes (SURVEY.md §5: distributed code paths are
-exercised in CI via ``--xla_force_host_platform_device_count=8`` with no
-pod). Keeping tests on CPU also keeps them hermetic w.r.t. the single real
-TPU chip used for benchmarking.
+SURVEY.md §5: distributed code paths are exercised in CI via a virtual
+multi-device CPU platform, no pod needed. NOTE: a pytest plugin imports
+jax before this conftest runs, so env vars (JAX_PLATFORMS/XLA_FLAGS) are
+too late — we must go through jax.config, which takes effect as long as no
+backend has been initialized yet.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # belt-and-braces for subprocesses
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_debug_nans", False)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 
 def pytest_report_header(config):

@@ -1,0 +1,128 @@
+"""Ensemble trainer (L5) tests: vmapped multi-seed training, seed
+diversity, stacked checkpoints, seed-sharded mesh, ensemble backtest path.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.train.ensemble import (
+    EnsembleTrainer,
+    load_ensemble,
+    run_ensemble_experiment,
+)
+
+
+def ens_cfg(tmp, n_seeds=4, **over):
+    base = dict(
+        name="t_ens",
+        data=DataConfig(n_firms=150, n_months=150, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=48),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=3e-3, epochs=3, warmup_steps=5,
+                          early_stop_patience=3, loss="mse"),
+        seed=0,
+        n_seeds=n_seeds,
+        out_dir=str(tmp),
+    )
+    base.update(over)
+    return RunConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=150, n_months=150, n_features=5, seed=31)
+
+
+@pytest.fixture(scope="module")
+def fitted(panel, tmp_path_factory):
+    cfg = ens_cfg(tmp_path_factory.mktemp("ens"), n_seeds=4)
+    summary, trainer, splits = run_ensemble_experiment(cfg, panel=panel)
+    return cfg, summary, trainer, splits
+
+
+def test_ensemble_trains_and_recovers_signal(fitted):
+    _, summary, _, _ = fitted
+    assert summary["n_seeds"] == 4
+    assert summary["best_val_ic"] > 0.1
+    hist = summary["history"]
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+
+
+def test_members_differ(fitted):
+    """Different seeds ⇒ different params and different forecasts —
+    the diversity requirement from SURVEY.md §8 ('hard parts')."""
+    _, _, trainer, splits = fitted
+    p = trainer.state.params
+    leaves = jax.tree.leaves(p)
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        assert arr.shape[0] == 4
+        if arr.ndim > 1 and arr.size >= 8:
+            assert not np.allclose(arr[0], arr[1]), "seeds collapsed"
+    stacked, valid = trainer.predict("test")
+    assert not np.allclose(stacked[0][valid], stacked[1][valid])
+
+
+def test_seed_mesh_sharding(fitted):
+    """State leaves must be sharded over the seed axis of the mesh
+    (4 seeds over the 8-device CPU mesh → seed axis 4)."""
+    _, _, trainer, _ = fitted
+    assert trainer.mesh is not None
+    assert trainer.mesh.shape["seed"] == 4
+    leaf = jax.tree.leaves(trainer.state.params)[0]
+    assert len(leaf.sharding.device_set) >= 4
+
+
+def test_per_seed_data_orders_differ(fitted):
+    _, _, trainer, _ = fitted
+    b0 = next(iter(trainer.samplers[0].epoch(0)))
+    b1 = next(iter(trainer.samplers[1].epoch(0)))
+    assert (not np.array_equal(b0.time_idx, b1.time_idx)
+            or not np.array_equal(b0.firm_idx, b1.firm_idx))
+
+
+def test_ensemble_checkpoint_roundtrip_and_backtest(fitted, panel):
+    cfg, _, trainer, splits = fitted
+    reloaded, rsplits = load_ensemble(
+        trainer.run_dir, panel=panel)
+    for a, b in zip(jax.tree.leaves(trainer.state.params),
+                    jax.tree.leaves(reloaded.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    stacked, valid = reloaded.predict("test")
+    assert stacked.shape[0] == cfg.n_seeds
+    for mode in ("mean", "mean_minus_std"):
+        fc, fcv = aggregate_ensemble(stacked, valid, mode)
+        rep = run_backtest(fc, fcv, rsplits.panel, min_universe=10)
+        assert rep.n_months > 0
+        assert np.isfinite(rep.sharpe_ann)
+
+
+def test_ensemble_beats_or_matches_worst_member(fitted):
+    """The ensemble mean forecast should not be worse than the worst
+    individual member on test IC (basic variance-reduction sanity)."""
+    from lfm_quant_tpu.ops import spearman_ic
+    import jax.numpy as jnp
+
+    _, _, trainer, splits = fitted
+    stacked, valid = trainer.predict("test")
+    t = splits.panel
+    member_ics = []
+    mask = valid & t.target_valid
+    for s in range(stacked.shape[0]):
+        member_ics.append(np.corrcoef(stacked[s][mask], t.targets[mask])[0, 1])
+    ens = stacked.mean(axis=0)
+    ens_ic = np.corrcoef(ens[mask], t.targets[mask])[0, 1]
+    assert ens_ic >= min(member_ics) - 1e-6
+
+
+def test_requires_two_seeds(panel, tmp_path):
+    from lfm_quant_tpu.data import PanelSplits
+    splits = PanelSplits.by_date(panel, 197910, 198101)
+    with pytest.raises(ValueError, match="n_seeds"):
+        EnsembleTrainer(ens_cfg(tmp_path, n_seeds=1), splits)

@@ -1,0 +1,156 @@
+"""Loss/metric (ops) tests against hand-computed and scipy fixtures.
+
+SURVEY.md §8 step 4: the rank-IC math is "the subtlest math in the repo;
+fixture-tested first".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats
+
+from lfm_quant_tpu.ops import (
+    gaussian_nll,
+    masked_huber,
+    masked_mse,
+    pearson_ic,
+    rank_ic_loss,
+    soft_rank,
+    spearman_ic,
+)
+
+
+def test_masked_mse_ignores_padding():
+    pred = jnp.asarray([[1.0, 2.0, 99.0]])
+    targ = jnp.asarray([[0.0, 1.0, 0.0]])
+    w = jnp.asarray([[1.0, 1.0, 0.0]])
+    assert float(masked_mse(pred, targ, w)) == pytest.approx(1.0)
+
+
+def test_masked_huber_quadratic_and_linear():
+    pred = jnp.asarray([[0.5, 3.0]])
+    targ = jnp.asarray([[0.0, 0.0]])
+    w = jnp.asarray([[1.0, 1.0]])
+    # |0.5| < delta → 0.5*0.25 ; |3| > 1 → 0.5 + (3-1) = 2.5
+    assert float(masked_huber(pred, targ, w)) == pytest.approx(
+        (0.125 + 2.5) / 2
+    )
+
+
+def test_gaussian_nll_matches_formula():
+    mean = jnp.asarray([[1.0]])
+    log_var = jnp.asarray([[np.log(4.0)]])
+    targ = jnp.asarray([[3.0]])
+    w = jnp.ones((1, 1))
+    expect = 0.5 * (np.log(4.0) + 4.0 / 4.0)
+    assert float(gaussian_nll(mean, log_var, targ, w)) == pytest.approx(
+        expect, rel=1e-6
+    )
+
+
+def test_soft_rank_approaches_hard_rank():
+    x = jnp.asarray([[0.3, -1.2, 2.5, 0.9]])
+    w = jnp.ones((1, 4))
+    sr = np.asarray(soft_rank(x, w, temperature=1e-4))[0]
+    # hard ranks (0-based) + 0.5 self term
+    expect = np.array([1, 0, 3, 2]) + 0.5
+    np.testing.assert_allclose(sr, expect, atol=1e-3)
+
+
+def test_soft_rank_padding_isolated():
+    x = jnp.asarray([[0.3, -1.2, 2.5, 100.0]])
+    w = jnp.asarray([[1.0, 1.0, 1.0, 0.0]])
+    sr = np.asarray(soft_rank(x, w, temperature=1e-4))[0]
+    np.testing.assert_allclose(sr[:3], np.array([1, 0, 2]) + 0.5, atol=1e-3)
+
+
+def test_spearman_matches_scipy():
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        a = rng.standard_normal(40)
+        b = 0.5 * a + rng.standard_normal(40)
+        ours = float(
+            spearman_ic(jnp.asarray(a)[None], jnp.asarray(b)[None], jnp.ones((1, 40)))[0]
+        )
+        ref = stats.spearmanr(a, b).statistic
+        assert ours == pytest.approx(ref, abs=1e-5)
+
+
+def test_spearman_with_padding_matches_scipy_on_subset():
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal(30)
+    b = rng.standard_normal(30)
+    w = np.ones(30)
+    w[20:] = 0.0
+    a_pad = a.copy()
+    a_pad[20:] = 1e9  # garbage in padded slots must not matter
+    ours = float(
+        spearman_ic(jnp.asarray(a_pad)[None], jnp.asarray(b)[None], jnp.asarray(w)[None])[0]
+    )
+    ref = stats.spearmanr(a[:20], b[:20]).statistic
+    assert ours == pytest.approx(ref, abs=1e-5)
+
+
+def test_pearson_matches_numpy():
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal(50)
+    b = -0.3 * a + rng.standard_normal(50)
+    ours = float(pearson_ic(jnp.asarray(a)[None], jnp.asarray(b)[None], jnp.ones((1, 50)))[0])
+    ref = np.corrcoef(a, b)[0, 1]
+    assert ours == pytest.approx(ref, abs=1e-5)
+
+
+def test_rank_ic_loss_perfect_and_anti_correlation():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 64)).astype(np.float32)
+    w = np.ones((4, 64), np.float32)
+    l_same = float(rank_ic_loss(jnp.asarray(x), jnp.asarray(x), jnp.asarray(w)))
+    l_anti = float(rank_ic_loss(jnp.asarray(-x), jnp.asarray(x), jnp.asarray(w)))
+    assert l_same < -0.95
+    assert l_anti > 0.95
+
+
+def test_rank_ic_loss_is_per_month():
+    """Month-wise constant offsets must not change the loss (ranking is
+    within month) — the sharding correctness trap from SURVEY.md §8."""
+    rng = np.random.default_rng(4)
+    pred = rng.standard_normal((6, 32)).astype(np.float32)
+    targ = rng.standard_normal((6, 32)).astype(np.float32)
+    w = np.ones((6, 32), np.float32)
+    base = float(rank_ic_loss(jnp.asarray(pred), jnp.asarray(targ), jnp.asarray(w)))
+    offs = rng.standard_normal((6, 1)).astype(np.float32) * 100
+    shifted = float(
+        rank_ic_loss(jnp.asarray(pred + offs), jnp.asarray(targ), jnp.asarray(w))
+    )
+    assert shifted == pytest.approx(base, abs=1e-4)
+
+
+def test_rank_ic_loss_gradient_points_the_right_way():
+    """One gradient step on the loss must increase the exact Spearman IC."""
+    rng = np.random.default_rng(5)
+    targ = jnp.asarray(rng.standard_normal((3, 48)).astype(np.float32))
+    pred0 = jnp.asarray(rng.standard_normal((3, 48)).astype(np.float32))
+    w = jnp.ones((3, 48))
+
+    g = jax.grad(lambda p: rank_ic_loss(p, targ, w))(pred0)
+    assert bool(jnp.isfinite(g).all())
+    pred1 = pred0 - 0.5 * g
+    ic0 = float(spearman_ic(pred0, targ, w).mean())
+    ic1 = float(spearman_ic(pred1, targ, w).mean())
+    assert ic1 > ic0
+
+
+def test_rank_ic_loss_ignores_padded_slots():
+    rng = np.random.default_rng(6)
+    pred = rng.standard_normal((2, 20)).astype(np.float32)
+    targ = rng.standard_normal((2, 20)).astype(np.float32)
+    w = np.ones((2, 20), np.float32)
+    w[:, 15:] = 0.0
+    base = float(rank_ic_loss(jnp.asarray(pred), jnp.asarray(targ), jnp.asarray(w)))
+    pred2 = pred.copy()
+    pred2[:, 15:] = 1e6
+    poisoned = float(
+        rank_ic_loss(jnp.asarray(pred2), jnp.asarray(targ), jnp.asarray(w))
+    )
+    assert poisoned == pytest.approx(base, abs=1e-4)

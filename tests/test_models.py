@@ -1,0 +1,153 @@
+"""Model (L3) tests: shapes, masking semantics, dtype policy, jit/grad."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.models import build_model
+
+B, W, F = 8, 24, 6
+KINDS = ["mlp", "lstm", "gru", "transformer"]
+
+
+def make_batch(seed=0, all_valid=False):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((B, W, F)).astype(np.float32)
+    if all_valid:
+        m = np.ones((B, W), dtype=bool)
+    else:
+        m = rng.random((B, W)) < 0.8
+        m[:, -1] = True  # anchor month always valid
+        m[0, : W // 2] = False  # one firm with a short history
+    x = np.where(m[..., None], x, 0.0)
+    return jnp.asarray(x), jnp.asarray(m)
+
+
+def init_and_apply(kind, x, m, **kw):
+    model = build_model(kind, **kw)
+    params = model.init(jax.random.key(0), x, m)
+    return model, params, model.apply(params, x, m)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_forward_shape_and_dtype(kind):
+    x, m = make_batch()
+    _, _, y = init_and_apply(kind, x, m)
+    assert y.shape == (B,)
+    assert y.dtype == jnp.float32
+    assert bool(jnp.isfinite(y).all())
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_bf16_compute_fp32_params_fp32_out(kind):
+    x, m = make_batch()
+    model = build_model(kind, dtype=jnp.bfloat16)
+    params = model.init(jax.random.key(0), x, m)
+    leaves = jax.tree.leaves(params)
+    assert all(l.dtype == jnp.float32 for l in leaves), "params must stay fp32"
+    y = model.apply(params, x, m)
+    assert y.dtype == jnp.float32, "head output must be fp32"
+    assert bool(jnp.isfinite(y).all())
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_masked_steps_do_not_affect_output(kind):
+    """Changing features inside masked months must not change the forecast."""
+    x, m = make_batch()
+    model = build_model(kind)
+    params = model.init(jax.random.key(0), x, m)
+    y0 = model.apply(params, x, m)
+    noise = jnp.asarray(
+        np.random.default_rng(1).standard_normal(x.shape).astype(np.float32)
+    )
+    x_perturbed = jnp.where(m[..., None], x, noise)
+    y1 = model.apply(params, x_perturbed, m)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), atol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["lstm", "gru"])
+def test_rnn_ignores_leading_padding_entirely(kind):
+    """A left-padded short history must equal the same history without pad."""
+    rng = np.random.default_rng(3)
+    w_short = W // 2
+    x_short = rng.standard_normal((B, w_short, F)).astype(np.float32)
+    m_short = np.ones((B, w_short), dtype=bool)
+    x_pad = np.concatenate([np.zeros((B, W - w_short, F), np.float32), x_short], 1)
+    m_pad = np.concatenate([np.zeros((B, W - w_short), bool), m_short], 1)
+    model = build_model(kind)
+    params = model.init(jax.random.key(0), jnp.asarray(x_pad), jnp.asarray(m_pad))
+    y_pad = model.apply(params, jnp.asarray(x_pad), jnp.asarray(m_pad))
+    y_short = model.apply(params, jnp.asarray(x_short), jnp.asarray(m_short))
+    np.testing.assert_allclose(np.asarray(y_pad), np.asarray(y_short), atol=1e-5)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_grad_flows_and_is_finite(kind):
+    x, m = make_batch()
+    model = build_model(kind)
+    params = model.init(jax.random.key(0), x, m)
+
+    def loss(p):
+        return jnp.mean(model.apply(p, x, m) ** 2)
+
+    g = jax.jit(jax.grad(loss))(params)
+    leaves = jax.tree.leaves(g)
+    assert all(bool(jnp.isfinite(l).all()) for l in leaves)
+    total = sum(float(jnp.abs(l).sum()) for l in leaves)
+    assert total > 0.0, "gradient identically zero"
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_jit_matches_eager(kind):
+    x, m = make_batch()
+    model, params, y = init_and_apply(kind, x, m)
+    yj = jax.jit(lambda p, x, m: model.apply(p, x, m))(params, x, m)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yj), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_heteroscedastic_head(kind):
+    x, m = make_batch()
+    model = build_model(kind, heteroscedastic=True)
+    params = model.init(jax.random.key(0), x, m)
+    mean, log_var = model.apply(params, x, m)
+    assert mean.shape == (B,) and log_var.shape == (B,)
+    assert bool(jnp.isfinite(mean).all()) and bool(jnp.isfinite(log_var).all())
+    assert float(jnp.abs(log_var).max()) <= 8.0
+
+
+def test_lstm_differs_from_gru():
+    x, m = make_batch(all_valid=True)
+    _, _, y_lstm = init_and_apply("lstm", x, m)
+    _, _, y_gru = init_and_apply("gru", x, m)
+    assert not np.allclose(np.asarray(y_lstm), np.asarray(y_gru))
+
+
+def test_rnn_multilayer():
+    x, m = make_batch()
+    _, _, y = init_and_apply("lstm", x, m, layers=2, hidden=32)
+    assert y.shape == (B,)
+    assert bool(jnp.isfinite(y).all())
+
+
+def test_unknown_kind_raises():
+    with pytest.raises(ValueError, match="unknown model kind"):
+        build_model("resnet")
+
+
+def test_mlp_anchor_only_mode():
+    x, m = make_batch()
+    _, _, y = init_and_apply("mlp", x, m, window_input=False)
+    assert y.shape == (B,)
+
+
+def test_rnn_uses_time_structure():
+    """Reversing the window order must change an RNN forecast (the planted
+    trend term in the synthetic panel is only learnable this way)."""
+    x, m = make_batch(all_valid=True)
+    model = build_model("lstm")
+    params = model.init(jax.random.key(0), x, m)
+    y = model.apply(params, x, m)
+    y_rev = model.apply(params, x[:, ::-1], m)
+    assert not np.allclose(np.asarray(y), np.asarray(y_rev), atol=1e-4)

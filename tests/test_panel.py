@@ -67,16 +67,34 @@ def test_returns_reward_good_forecasts(panel):
     assert ic > 0.05, f"returns not loaded on signal: corr={ic:.3f}"
 
 
-def test_date_slice_and_splits(panel):
+def test_date_slice(panel):
     d0 = int(panel.dates[0])
+    sl = panel.date_slice(d0, 198001)
+    assert int(sl.dates[-1]) < 198001
+    assert sl.n_firms == panel.n_firms
+
+
+def test_splits_are_anchor_ranges(panel):
     splits = PanelSplits.by_date(panel, train_end=198001, val_end=198201)
-    assert int(splits.train.dates[0]) == d0
-    assert int(splits.train.dates[-1]) < 198001
-    assert int(splits.val.dates[0]) >= 198001
-    assert int(splits.val.dates[-1]) < 198201
-    assert int(splits.test.dates[0]) >= 198201
-    total = splits.train.n_months + splits.val.n_months + splits.test.n_months
-    assert total == panel.n_months
+    assert splits.panel is panel  # shared, not sliced
+    lo, hi = splits.train_range
+    assert lo == 0
+    # Training anchors are embargoed `horizon` months before train_end.
+    assert int(panel.dates[hi + panel.horizon - 1]) < 198001
+    vlo, vhi = splits.val_range
+    assert int(panel.dates[vlo]) >= 198001
+    # Val anchors are embargoed too: last val target realized before test.
+    assert int(panel.dates[vhi + panel.horizon - 1]) < 198201
+    tlo, thi = splits.test_range
+    assert int(panel.dates[tlo]) >= 198201 and thi == panel.n_months
+    assert splits.range_of("val") == splits.val_range
+    with pytest.raises(ValueError, match="unknown split"):
+        splits.range_of("holdout")
+    with pytest.raises(ValueError, match="strictly inside"):
+        PanelSplits.by_date(panel, 196001, 198001)
+    # Periods shorter than the horizon cannot host embargoed anchors.
+    with pytest.raises(ValueError, match="horizon"):
+        PanelSplits.by_date(panel, 198001, 198006)
 
 
 def test_save_load_roundtrip(tmp_path, panel):

@@ -1,0 +1,121 @@
+"""Parallelism tests on the virtual 8-device CPU mesh (SURVEY.md §5:
+distributed code paths run in CI via xla_force_host_platform_device_count).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+from lfm_quant_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    replicated,
+    seed_sharding,
+    shard_batch,
+)
+from lfm_quant_tpu.train import Trainer
+
+
+def test_devices_available():
+    assert jax.device_count() == 8, "conftest must provide 8 CPU devices"
+
+
+def test_make_mesh_shapes():
+    m = make_mesh(4, 2)
+    assert m.shape == {"seed": 4, "data": 2}
+    m2 = make_mesh(2)  # data defaults to 8//2
+    assert m2.shape == {"seed": 2, "data": 4}
+    with pytest.raises(ValueError, match="needs"):
+        make_mesh(8, 2)
+    with pytest.raises(ValueError, match="divisible"):
+        make_mesh(3)
+
+
+def test_shard_batch_placement():
+    mesh = make_mesh(1, 8)
+    fi = jnp.zeros((8, 16), jnp.int32)
+    ti = jnp.zeros((8,), jnp.int32)
+    w = jnp.ones((8, 16), jnp.float32)
+    fi_s, ti_s, w_s = shard_batch(mesh, (fi, ti, w))
+    assert len(fi_s.sharding.device_set) == 8
+    # Date axis sharded: each device holds one date row.
+    assert fi_s.addressable_shards[0].data.shape == (1, 16)
+    assert ti_s.addressable_shards[0].data.shape == (1,)
+
+
+def test_seed_axis_sharding():
+    mesh = make_mesh(8, 1)
+    x = jnp.zeros((8, 3, 5))
+    xs = jax.device_put(x, seed_sharding(mesh))
+    assert xs.addressable_shards[0].data.shape == (1, 3, 5)
+
+
+def _fit_cfg(panel, n_shards, tmp, seed=0):
+    return RunConfig(
+        name=f"dp{n_shards}",
+        data=DataConfig(n_firms=150, n_months=150, n_features=5, window=12,
+                        dates_per_batch=8, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=2, warmup_steps=5,
+                          early_stop_patience=5, loss="mse"),
+        seed=seed,
+        n_data_shards=n_shards,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=150, n_months=150, n_features=5, seed=13)
+
+
+def test_dp_training_matches_single_device(panel, tmp_path):
+    """Date-sharded DP must be numerically equivalent to single-device
+    training — same batches, same model, same loss math (the SURVEY.md §8
+    step-8 correctness requirement)."""
+    splits = PanelSplits.by_date(panel, 197910, 198101)
+
+    t1 = Trainer(_fit_cfg(panel, 1, tmp_path / "a"), splits)
+    t8 = Trainer(_fit_cfg(panel, 8, tmp_path / "b"), splits)
+    assert t8.mesh is not None and t8.mesh.shape["data"] == 8
+
+    s1, s8 = t1.init_state(), t8.init_state()
+    for b in t1.train_sampler.epoch(0):
+        a1 = t1._batch_args(b, train=True)
+        a8 = t8._batch_args(b, train=True)
+        s1, m1 = t1._jit_step(s1, t1.dev, *a1)
+        s8, m8 = t8._jit_step(s8, t8.dev, *a8)
+    assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), rel=1e-4)
+    for l1, l8 in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l8),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_dp_rank_ic_loss_shard_local(panel, tmp_path):
+    """rank_ic ranks within months; sharding dates across devices must not
+    change the loss value."""
+    splits = PanelSplits.by_date(panel, 197910, 198101)
+    cfg1 = _fit_cfg(panel, 1, tmp_path / "a")
+    cfg8 = _fit_cfg(panel, 8, tmp_path / "b")
+    import dataclasses
+    cfg1 = dataclasses.replace(cfg1, optim=dataclasses.replace(cfg1.optim, loss="rank_ic"))
+    cfg8 = dataclasses.replace(cfg8, optim=dataclasses.replace(cfg8.optim, loss="rank_ic"))
+    t1, t8 = Trainer(cfg1, splits), Trainer(cfg8, splits)
+    s1, s8 = t1.init_state(), t8.init_state()
+    b = next(iter(t1.train_sampler.epoch(0)))
+    _, m1 = t1._jit_step(s1, t1.dev, *t1._batch_args(b, train=True))
+    _, m8 = t8._jit_step(s8, t8.dev, *t8._batch_args(b, train=True))
+    assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), rel=1e-4)
+
+
+def test_indivisible_batch_raises(panel, tmp_path):
+    splits = PanelSplits.by_date(panel, 197910, 198101)
+    cfg = _fit_cfg(panel, 8, tmp_path)
+    import dataclasses
+    cfg = dataclasses.replace(
+        cfg, data=dataclasses.replace(cfg.data, dates_per_batch=6))
+    with pytest.raises(ValueError, match="divisible"):
+        Trainer(cfg, splits)

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Training entry point — parity with the reference's ``train.py``
+(SURVEY.md §4.1; BASELINE.json:5): config → panel → model → (ensemble)
+training → checkpoints + metrics.
+
+Usage:
+    python train.py --preset c1                 # ladder preset (c1..c5)
+    python train.py --config my_config.json     # explicit config file
+    python train.py --preset c2 --seed 3 --epochs 5 --echo
+
+Multi-seed presets (n_seeds > 1) run the vmap'd ensemble trainer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--preset", help="ladder preset name (c1..c5 or full name)")
+    g.add_argument("--config", help="path to a RunConfig JSON file")
+    ap.add_argument("--seed", type=int, default=None, help="override seed")
+    ap.add_argument("--epochs", type=int, default=None, help="override epochs")
+    ap.add_argument("--n-seeds", type=int, default=None,
+                    help="override ensemble size")
+    ap.add_argument("--out", default=None, help="override output dir")
+    ap.add_argument("--echo", action="store_true", help="print metrics lines")
+    ap.add_argument("--scale", type=float, default=None,
+                    help="shrink the synthetic panel by this factor (smoke runs)")
+    args = ap.parse_args(argv)
+
+    # Import late so --help works instantly without initializing JAX.
+    import dataclasses
+
+    from lfm_quant_tpu.config import RunConfig, get_preset
+
+    if args.preset:
+        cfg = get_preset(args.preset)
+    else:
+        with open(args.config) as fh:
+            cfg = RunConfig.from_json(fh.read())
+    if args.seed is not None:
+        cfg = dataclasses.replace(cfg, seed=args.seed)
+    if args.epochs is not None:
+        cfg = dataclasses.replace(
+            cfg, optim=dataclasses.replace(cfg.optim, epochs=args.epochs))
+    if args.n_seeds is not None:
+        cfg = dataclasses.replace(cfg, n_seeds=args.n_seeds)
+    if args.out is not None:
+        cfg = dataclasses.replace(cfg, out_dir=args.out)
+    if args.scale is not None:
+        d = cfg.data
+        cfg = dataclasses.replace(cfg, data=dataclasses.replace(
+            d,
+            n_firms=max(64, int(d.n_firms * args.scale)),
+            # Floor keeps the scaled panel valid: longer than the synthetic
+            # generator's min_history (72) with room for window + splits.
+            n_months=max(d.window + d.horizon + 96, 120,
+                         int(d.n_months * args.scale)),
+        ))
+
+    if cfg.n_seeds > 1:
+        from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
+        summary, _, _ = run_ensemble_experiment(cfg, echo=args.echo)
+    else:
+        from lfm_quant_tpu.train.loop import run_experiment
+        summary, _, _ = run_experiment(cfg, echo=args.echo)
+    print(json.dumps({k: v for k, v in summary.items() if k != "history"},
+                     indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
