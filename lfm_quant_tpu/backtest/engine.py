@@ -119,8 +119,11 @@ def run_backtest(
     rets, ics, ret_ics, dates, turns = [], [], [], [], []
     prev_long: Optional[set] = None
     skipped = 0
+    # tradeable() excludes firms whose forward return is unobserved (e.g.
+    # delisting at t+1) — crediting them 0% would mask delisting losses.
+    tradeable = panel.tradeable()
     for t in range(t_len):
-        uni = np.nonzero(fc_valid[:, t] & panel.valid[:, t])[0]
+        uni = np.nonzero(fc_valid[:, t] & tradeable[:, t])[0]
         if uni.size < min_universe:
             skipped += 1
             continue

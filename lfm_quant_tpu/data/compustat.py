@@ -1,0 +1,209 @@
+"""Real-data loader: Compustat-style long-format files → Panel (L1).
+
+Parity target: the reference's panel loader / preprocessor reading
+Compustat-style fundamentals into firm×month matrices (SURVEY.md §3;
+BASELINE.json:5). The reference's format was unobservable (SURVEY.md §0),
+so this loader defines and documents its own simple interchange schema:
+
+Long format (CSV or parquet), one row per (firm, month):
+
+    gvkey,yyyymm,<feature columns...>,ret
+    1001,199001,0.08,1.2,...,0.013
+
+* ``gvkey``   — integer firm identifier (any stable int id).
+* ``yyyymm``  — calendar month.
+* features   — raw fundamental/price-derived columns (any numeric names).
+* ``ret``     — TRAILING 1-month total return (month t-1 → t close), the
+  convention vendor files use; converted to the forward returns the
+  backtester needs.
+
+Preprocessing (the standard cross-sectional factor recipe):
+
+1. winsorize each feature per month at configurable quantiles;
+2. z-score each feature within the month's cross-section (so every
+   feature is a comparable cross-sectional signal, and the planted-signal
+   tests on synthetic data transfer to real data unchanged);
+3. the forecast target at anchor t is the *standardized* value of
+   ``target_col`` observed at t+horizon (lookahead-factor convention:
+   predict where the firm's factor will stand a year from now);
+4. validity masks from row presence; missing (firm, month) rows or NaN
+   features ⇒ invalid cell, zero-filled.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from lfm_quant_tpu.data.panel import Panel
+
+RESERVED = ("gvkey", "yyyymm", "ret")
+
+
+def _read_table(path: str) -> pd.DataFrame:
+    if path.endswith((".parquet", ".pq")):
+        return pd.read_parquet(path)
+    return pd.read_csv(path)
+
+
+def _month_grid(months: np.ndarray) -> np.ndarray:
+    """Full consecutive YYYYMM range spanning the observed months."""
+    lo, hi = int(months.min()), int(months.max())
+    y, m = lo // 100, lo % 100
+    out = []
+    while y * 100 + m <= hi:
+        out.append(y * 100 + m)
+        m += 1
+        if m > 12:
+            m, y = 1, y + 1
+    return np.asarray(out, dtype=np.int32)
+
+
+def load_compustat_csv(
+    path: str,
+    feature_cols: Optional[Sequence[str]] = None,
+    target_col: Optional[str] = None,
+    horizon: int = 12,
+    winsor: Tuple[float, float] = (0.01, 0.99),
+    min_cross_section: int = 5,
+) -> Panel:
+    """Load a long-format fundamentals file into a :class:`Panel`.
+
+    Args:
+      path: CSV or parquet file in the documented schema.
+      feature_cols: columns to use as features (default: every non-reserved
+        numeric column, in file order).
+      target_col: which (standardized) feature the model forecasts
+        ``horizon`` months ahead (default: the first feature).
+      horizon: forecast lookahead in months.
+      winsor: per-month winsorization quantiles (lo, hi); None disables.
+      min_cross_section: months with fewer valid firms than this are left
+        unstandardized-invalid (degenerate z-scores are worse than no data).
+    """
+    df = _read_table(path)
+    missing = [c for c in ("gvkey", "yyyymm") if c not in df.columns]
+    if missing:
+        raise ValueError(f"input file lacks required columns {missing}")
+    if df.duplicated(["gvkey", "yyyymm"]).any():
+        dupes = df[df.duplicated(["gvkey", "yyyymm"], keep=False)]
+        raise ValueError(
+            f"duplicate (gvkey, yyyymm) rows, e.g.\n{dupes.head(3)}")
+
+    if feature_cols is None:
+        feature_cols = [
+            c for c in df.columns
+            if c not in RESERVED and pd.api.types.is_numeric_dtype(df[c])
+        ]
+        ignored = [c for c in df.columns
+                   if c not in RESERVED and c not in feature_cols]
+        if ignored:
+            import sys
+
+            print(f"load_compustat_csv: ignoring non-numeric columns "
+                  f"{ignored}", file=sys.stderr)
+    if not feature_cols:
+        raise ValueError("no feature columns found")
+    if target_col is None:
+        target_col = feature_cols[0]
+    if target_col not in feature_cols:
+        raise ValueError(
+            f"target_col {target_col!r} must be one of the features "
+            f"{list(feature_cols)}")
+
+    dates = _month_grid(df["yyyymm"].to_numpy())
+    firms = np.sort(df["gvkey"].unique()).astype(np.int32)
+    n, t, f = len(firms), len(dates), len(feature_cols)
+    firm_pos = {g: i for i, g in enumerate(firms)}
+    date_pos = {d: j for j, d in enumerate(dates)}
+
+    feats = np.full((n, t, f), np.nan, dtype=np.float32)
+    rets = np.full((n, t), np.nan, dtype=np.float32)
+    rows = df["gvkey"].map(firm_pos).to_numpy()
+    cols = df["yyyymm"].map(date_pos).to_numpy()
+    feats[rows, cols] = df[list(feature_cols)].to_numpy(dtype=np.float32)
+    if "ret" in df.columns:
+        rets[rows, cols] = df["ret"].to_numpy(dtype=np.float32)
+
+    valid = ~np.isnan(feats).any(axis=2)
+
+    # Per-month winsorize + z-score over the valid cross-section.
+    for j in range(t):
+        rowsel = valid[:, j]
+        if rowsel.sum() < min_cross_section:
+            valid[:, j] = False
+            continue
+        x = feats[rowsel, j, :]
+        if winsor is not None:
+            # Order-statistic quantiles (no interpolation): an interpolated
+            # 99th pct is itself dragged by a single extreme outlier.
+            lo = np.nanquantile(x, winsor[0], axis=0, method="higher")
+            hi = np.nanquantile(x, winsor[1], axis=0, method="lower")
+            x = np.clip(x, lo, hi)
+        mu = x.mean(axis=0)
+        sd = x.std(axis=0)
+        sd = np.where(sd < 1e-8, 1.0, sd)
+        feats[rowsel, j, :] = (x - mu) / sd
+
+    feats = np.where(valid[..., None], feats, 0.0).astype(np.float32)
+
+    # Targets: standardized target feature at t+horizon.
+    ti = list(feature_cols).index(target_col)
+    targets = np.zeros((n, t), dtype=np.float32)
+    target_valid = np.zeros((n, t), dtype=bool)
+    if horizon < t:
+        future = feats[:, horizon:, ti]
+        fvalid = valid[:, horizon:]
+        targets[:, :-horizon] = np.where(fvalid, future, 0.0)
+        target_valid[:, :-horizon] = valid[:, :-horizon] & fvalid
+
+    # Returns: vendor files carry trailing returns (t-1 → t); the backtest
+    # wants the forward return earned from holding over [t, t+1]. A missing
+    # t+1 observation (delisting, gap) makes the forward return UNOBSERVED
+    # — flagged in ret_valid, never fabricated as 0% (delisting bias).
+    fwd = np.zeros((n, t), dtype=np.float32)
+    ret_valid = np.zeros((n, t), dtype=bool)
+    if "ret" not in df.columns:
+        # No return data at all: every cell unobserved; backtests on this
+        # panel are meaningless and will raise on an empty universe.
+        pass
+    elif t > 1:
+        nxt = rets[:, 1:]
+        obs = ~np.isnan(nxt)
+        fwd[:, :-1] = np.where(obs, nxt, 0.0)
+        ret_valid[:, :-1] = obs & valid[:, :-1]
+    fwd = np.where(valid, fwd, 0.0).astype(np.float32)
+
+    panel = Panel(
+        features=feats,
+        targets=targets,
+        target_valid=target_valid,
+        valid=valid,
+        returns=fwd,
+        dates=dates,
+        firm_ids=firms,
+        feature_names=list(feature_cols),
+        horizon=horizon,
+        ret_valid=ret_valid,
+    )
+    panel.validate()
+    return panel
+
+
+def to_long_frame(panel: Panel) -> pd.DataFrame:
+    """Inverse helper: Panel → long-format DataFrame (fixtures, exports).
+    Emits one row per valid (firm, month); ``ret`` is re-expressed in the
+    trailing convention (row t carries the return from t-1 to t)."""
+    n, t = panel.valid.shape
+    fi, ti = np.nonzero(panel.valid)
+    data = {
+        "gvkey": panel.firm_ids[fi],
+        "yyyymm": panel.dates[ti],
+    }
+    for k, name in enumerate(panel.feature_names):
+        data[name] = panel.features[fi, ti, k]
+    trailing = np.zeros_like(panel.returns)
+    trailing[:, 1:] = panel.returns[:, :-1]
+    data["ret"] = trailing[fi, ti]
+    return pd.DataFrame(data)

@@ -75,6 +75,11 @@ class Panel:
         last live month, minus missing rows).
       returns:  ``[N, T]`` float32 — forward 1-month total return from month
         t to t+1, used by the backtester. Zero-filled where invalid.
+      ret_valid: ``[N, T]`` bool or None — forward return OBSERVED (firm
+        still listed at t+1). None means "trust ``valid``". Distinct from
+        ``valid`` to prevent delisting/survivorship bias: a firm with
+        features at t but no t+1 observation must be excluded from the
+        month-t tradeable universe, not credited a fabricated 0% return.
       dates:    ``[T]`` int32 — months as YYYYMM.
       firm_ids: ``[N]`` int32 — stable firm identifiers (gvkey-style).
       feature_names: length-F list of feature names.
@@ -90,6 +95,7 @@ class Panel:
     firm_ids: np.ndarray
     feature_names: Sequence[str]
     horizon: int = 12
+    ret_valid: Optional[np.ndarray] = None
 
     @property
     def n_firms(self) -> int:
@@ -120,6 +126,15 @@ class Panel:
         assert np.all(np.isfinite(self.features))
         assert np.all(np.isfinite(self.targets))
         assert np.all(np.isfinite(self.returns))
+        if self.ret_valid is not None:
+            assert self.ret_valid.shape == (n, t)
+            assert self.ret_valid.dtype == np.bool_
+
+    def tradeable(self) -> np.ndarray:
+        """``[N, T]`` bool: in-universe AND forward return observed."""
+        if self.ret_valid is None:
+            return self.valid
+        return self.valid & self.ret_valid
 
     def date_slice(self, start: int, stop: int) -> "Panel":
         """Restrict the panel to months with start <= YYYYMM < stop."""
@@ -136,10 +151,15 @@ class Panel:
             valid=self.valid[:, lo:hi],
             returns=self.returns[:, lo:hi],
             dates=self.dates[lo:hi],
+            ret_valid=(None if self.ret_valid is None
+                       else self.ret_valid[:, lo:hi]),
         )
 
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
+        extra = {}
+        if self.ret_valid is not None:
+            extra["ret_valid"] = self.ret_valid
         np.savez_compressed(
             os.path.join(path, "panel.npz"),
             features=self.features,
@@ -149,6 +169,7 @@ class Panel:
             returns=self.returns,
             dates=self.dates,
             firm_ids=self.firm_ids,
+            **extra,
         )
         with open(os.path.join(path, "panel_meta.json"), "w") as fh:
             json.dump(
@@ -173,6 +194,7 @@ def load_panel(path: str) -> Panel:
         firm_ids=arrays["firm_ids"],
         feature_names=meta["feature_names"],
         horizon=meta["horizon"],
+        ret_valid=arrays.get("ret_valid"),
     )
     p.validate()
     return p
@@ -293,6 +315,10 @@ def synthetic_panel(
     targets = np.where(target_valid, targets, 0.0).astype(np.float32)
     returns = np.where(valid, returns, 0.0).astype(np.float32)
 
+    # Forward return observable only while the firm is still listed at t+1.
+    ret_valid = np.zeros_like(valid)
+    ret_valid[:, :-1] = valid[:, :-1] & valid[:, 1:]
+
     panel = Panel(
         features=feats,
         targets=targets,
@@ -303,6 +329,7 @@ def synthetic_panel(
         firm_ids=np.arange(1, n_firms + 1, dtype=np.int32),
         feature_names=names,
         horizon=horizon,
+        ret_valid=ret_valid,
     )
     panel.validate()
     return panel

@@ -44,7 +44,7 @@ from lfm_quant_tpu.parallel import (
     state_sharding,
 )
 from lfm_quant_tpu.train.checkpoint import CheckpointManager
-from lfm_quant_tpu.train.loop import TrainState, Trainer
+from lfm_quant_tpu.train.loop import FitHarness, TrainState, Trainer
 from lfm_quant_tpu.utils.logging import MetricsLogger
 from lfm_quant_tpu.utils.profiling import StepTimer
 
@@ -169,19 +169,25 @@ class EnsembleTrainer:
         return {"ic_per_seed": per_seed, "ic_mean": float(per_seed.mean()),
                 "ic_std": float(per_seed.std())}
 
-    def fit(self) -> Dict[str, Any]:
+    def fit(self, resume: bool = False) -> Dict[str, Any]:
+        """Lock-step ensemble training with crash resume (ckpt/latest every
+        epoch) and best-model tracking (ckpt/best) — see Trainer.fit."""
         cfg = self.cfg
         if cfg.optim.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {cfg.optim.epochs}")
         state = self.init_state()
-        ckpt_dir = os.path.join(self.run_dir, "ckpt") if self.run_dir else None
-        ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
+        harness = FitHarness(self.run_dir, cfg.optim.epochs,
+                             cfg.optim.early_stop_patience,
+                             min(s.batches_per_epoch() for s in self.samplers))
+        if resume:
+            restored = harness.resume(state._asdict())
+            if restored is not None:
+                state = TrainState(**restored)
         logger = MetricsLogger(self.run_dir, echo=self.echo)
         timer = StepTimer()
 
-        best_ic, best_epoch, bad_epochs = -np.inf, -1, 0
         history = []
-        for epoch in range(cfg.optim.epochs):
+        while (epoch := harness.next_epoch()) is not None:
             timer.start()
             # Whole epoch × all seeds in one compiled dispatch.
             fi, ti, w = self._stacked_epoch(epoch)
@@ -191,8 +197,9 @@ class EnsembleTrainer:
             timer.stop(firm_months=fm)
 
             val = self.evaluate(state.params)
+            step_now = int(np.asarray(state.step)[0])
             rec = logger.log(
-                int(np.asarray(state.step)[0]),
+                step_now,
                 epoch=epoch,
                 train_loss=mean_loss,
                 val_ic=val["ic_mean"],
@@ -200,27 +207,19 @@ class EnsembleTrainer:
                 firm_months_per_sec=timer.throughput(),
             )
             history.append(rec)
+            if harness.end_epoch(epoch, step_now, state._asdict(),
+                                 val["ic_mean"]):
+                break
 
-            if val["ic_mean"] > best_ic:
-                best_ic, best_epoch, bad_epochs = val["ic_mean"], epoch, 0
-                if ckpt:
-                    ckpt.save(int(np.asarray(state.step)[0]),
-                              state._asdict(), wait=True)
-            else:
-                bad_epochs += 1
-                if bad_epochs >= cfg.optim.early_stop_patience:
-                    break
-
-        if ckpt and best_epoch >= 0:
-            restored = ckpt.restore(state._asdict())
-            state = TrainState(**restored)
-            ckpt.close()
+        best = harness.finalize(state._asdict())
+        if best is not None:
+            state = TrainState(**best)
         logger.close()
         self.state = state
         return {
-            "best_val_ic": best_ic,
-            "best_epoch": best_epoch,
-            "epochs_run": epoch + 1,
+            "best_val_ic": harness.best_ic,
+            "best_epoch": harness.best_epoch,
+            "epochs_run": harness.last_epoch + 1,
             "n_seeds": self.n_seeds,
             "firm_months_per_sec": timer.throughput(),
             "history": history,
@@ -254,20 +253,13 @@ class EnsembleTrainer:
 
 
 def run_ensemble_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
-                            echo: bool = False):
+                            echo: bool = False, resume: bool = False):
     """Config → panel → splits → vmapped ensemble training → summary."""
-    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
+    from lfm_quant_tpu.train.loop import resolve_panel
 
     d = cfg.data
     if panel is None:
-        if d.panel_path:
-            panel = load_panel(d.panel_path)
-        else:
-            panel = synthetic_panel(
-                n_firms=d.n_firms, n_months=d.n_months,
-                n_features=d.n_features, start_yyyymm=d.start_yyyymm,
-                horizon=d.horizon, seed=d.panel_seed,
-            )
+        panel = resolve_panel(d)
     dates = panel.dates
     train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
     val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
@@ -275,7 +267,7 @@ def run_ensemble_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
 
     run_dir = os.path.join(cfg.out_dir, cfg.name, "ensemble")
     trainer = EnsembleTrainer(cfg, splits, run_dir=run_dir, echo=echo)
-    summary = trainer.fit()
+    summary = trainer.fit(resume=resume)
     summary["run_dir"] = run_dir
     summary["config"] = dataclasses.asdict(cfg)
     os.makedirs(run_dir, exist_ok=True)
@@ -292,27 +284,20 @@ def run_ensemble_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
 def load_ensemble(run_dir: str, panel: Optional[Panel] = None):
     """Rebuild an EnsembleTrainer from a run dir + restore the stacked
     checkpoint (backtest.py ensemble path)."""
-    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
+    from lfm_quant_tpu.train.loop import resolve_panel
 
     with open(os.path.join(run_dir, "config.json")) as fh:
         cfg = RunConfig.from_json(fh.read())
     d = cfg.data
     if panel is None:
-        if d.panel_path:
-            panel = load_panel(d.panel_path)
-        else:
-            panel = synthetic_panel(
-                n_firms=d.n_firms, n_months=d.n_months,
-                n_features=d.n_features, start_yyyymm=d.start_yyyymm,
-                horizon=d.horizon, seed=d.panel_seed,
-            )
+        panel = resolve_panel(d)
     dates = panel.dates
     train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
     val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
     splits = PanelSplits.by_date(panel, train_end, val_end)
     trainer = EnsembleTrainer(cfg, splits, run_dir=run_dir)
     state = trainer.init_state()
-    ckpt = CheckpointManager(os.path.join(run_dir, "ckpt"))
+    ckpt = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))
     restored = ckpt.restore(state._asdict())
     ckpt.close()
     trainer.state = TrainState(**restored)

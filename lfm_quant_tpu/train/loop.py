@@ -76,6 +76,121 @@ def _point_forecast(out):
     return out[0] if isinstance(out, tuple) else out
 
 
+def load_progress(run_dir: str) -> Dict[str, Any]:
+    """Read the fit-progress sidecar used for crash resume."""
+    with open(os.path.join(run_dir, "fit_progress.json")) as fh:
+        return json.load(fh)
+
+
+def save_progress(run_dir: Optional[str], **kw) -> None:
+    """Atomic write: a preemption mid-dump must never leave a truncated
+    sidecar (that would make the crash-resume feature itself unresumable)."""
+    if run_dir:
+        path = os.path.join(run_dir, "fit_progress.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(kw, fh)
+        os.replace(tmp, path)
+
+
+class FitHarness:
+    """Shared fit scaffolding for Trainer and EnsembleTrainer: dual
+    checkpoint lines (ckpt/latest every epoch for crash resume, ckpt/best
+    on val-IC improvement for the final model), atomic progress sidecar,
+    early stopping, and resume semantics (SURVEY.md §6 failure recovery).
+
+    Usage:
+        h = FitHarness(run_dir, epochs, patience, steps_per_epoch)
+        state_dict = h.resume(state._asdict()) if resume else None
+        while h.next_epoch() is not None: ... h.end_epoch(...)
+        best = h.finalize(state._asdict())
+    """
+
+    def __init__(self, run_dir: Optional[str], epochs: int, patience: int,
+                 steps_per_epoch: int):
+        self.run_dir = run_dir
+        self.epochs = epochs
+        self.patience = patience
+        self.steps_per_epoch = max(1, steps_per_epoch)
+        self.latest_mgr = self.best_mgr = None
+        if run_dir:
+            self.latest_mgr = CheckpointManager(
+                os.path.join(run_dir, "ckpt", "latest"), max_to_keep=2)
+            self.best_mgr = CheckpointManager(
+                os.path.join(run_dir, "ckpt", "best"), max_to_keep=1)
+        self.best_ic, self.best_epoch, self.bad_epochs = -np.inf, -1, 0
+        self.start_epoch = 0
+        self._epoch = -1
+
+    def resume(self, abstract_state_dict) -> Optional[Dict[str, Any]]:
+        """Restore the latest checkpoint + loop counters. Returns the
+        restored state dict, or None when nothing is checkpointed. A
+        missing/corrupt sidecar (crash inside the persist window) degrades
+        to counters derived from the checkpoint step instead of failing."""
+        if not self.latest_mgr:
+            return None
+        step = self.latest_mgr.latest_step()
+        if step is None:
+            return None
+        restored = self.latest_mgr.restore(abstract_state_dict)
+        try:
+            prog = load_progress(self.run_dir)
+            self.start_epoch = prog["epoch"] + 1
+            self.best_ic = prog["best_ic"]
+            self.best_epoch = prog["best_epoch"]
+            self.bad_epochs = prog["bad_epochs"]
+        except (FileNotFoundError, json.JSONDecodeError, KeyError):
+            self.start_epoch = int(step) // self.steps_per_epoch
+            self.best_ic, self.best_epoch, self.bad_epochs = -np.inf, -1, 0
+        self._epoch = self.start_epoch - 1
+        return restored
+
+    def next_epoch(self) -> Optional[int]:
+        """The next epoch to train, or None when done — including a resumed
+        run that had already early-stopped (bad_epochs >= patience must not
+        restart training)."""
+        nxt = self._epoch + 1 if self._epoch >= self.start_epoch - 1 else \
+            self.start_epoch
+        if nxt >= self.epochs or self.bad_epochs >= self.patience:
+            return None
+        self._epoch = nxt
+        return nxt
+
+    @property
+    def last_epoch(self) -> int:
+        """Epoch counter for reporting (start_epoch-1 if no epoch ran)."""
+        return max(self._epoch, self.start_epoch - 1)
+
+    def end_epoch(self, epoch: int, step: int, state_dict, val_ic: float
+                  ) -> bool:
+        """Record an epoch: update best, persist both checkpoint lines and
+        the progress sidecar. Returns True when early stopping triggers."""
+        if val_ic > self.best_ic:
+            self.best_ic, self.best_epoch, self.bad_epochs = val_ic, epoch, 0
+            if self.best_mgr:
+                self.best_mgr.save(step, state_dict, wait=True)
+        else:
+            self.bad_epochs += 1
+        if self.latest_mgr:
+            self.latest_mgr.save(step, state_dict, wait=True)
+            save_progress(self.run_dir, epoch=epoch,
+                          best_ic=float(self.best_ic),
+                          best_epoch=self.best_epoch,
+                          bad_epochs=self.bad_epochs)
+        return self.bad_epochs >= self.patience
+
+    def finalize(self, abstract_state_dict) -> Optional[Dict[str, Any]]:
+        """Restore the best state (if any) and close the managers."""
+        best = None
+        if (self.best_mgr and self.best_epoch >= 0
+                and self.best_mgr.latest_step() is not None):
+            best = self.best_mgr.restore(abstract_state_dict)
+        if self.latest_mgr:
+            self.latest_mgr.close()
+            self.best_mgr.close()
+        return best
+
+
 class Trainer:
     """Single-seed trainer: fit on splits.train, early-stop on splits.val.
 
@@ -231,19 +346,28 @@ class Trainer:
             "n_months": int(counts.size),
         }
 
-    def fit(self) -> Dict[str, Any]:
+    def fit(self, resume: bool = False) -> Dict[str, Any]:
+        """Train with early stopping; ``resume=True`` continues from the
+        latest per-epoch checkpoint after a crash/preemption (SURVEY.md §6
+        "failure detection / recovery": Orbax resume-from-latest — two
+        checkpoint lines are kept, ``ckpt/latest`` every epoch for recovery
+        and ``ckpt/best`` on val-IC improvement for the final model)."""
         cfg = self.cfg
         if cfg.optim.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {cfg.optim.epochs}")
         state = self.init_state()
-        ckpt_dir = os.path.join(self.run_dir, "ckpt") if self.run_dir else None
-        ckpt = CheckpointManager(ckpt_dir) if ckpt_dir else None
+        harness = FitHarness(self.run_dir, cfg.optim.epochs,
+                             cfg.optim.early_stop_patience,
+                             self.train_sampler.batches_per_epoch())
+        if resume:
+            restored = harness.resume(state._asdict())
+            if restored is not None:
+                state = TrainState(**restored)
         logger = MetricsLogger(self.run_dir, echo=self.echo)
         timer = StepTimer()
 
-        best_ic, best_epoch, bad_epochs = -np.inf, -1, 0
         history = []
-        for epoch in range(cfg.optim.epochs):
+        while (epoch := harness.next_epoch()) is not None:
             timer.start()
             # Whole epoch in one compiled dispatch (lax.scan over steps).
             b = self.train_sampler.stacked_epoch(epoch)
@@ -266,27 +390,20 @@ class Trainer:
                 firm_months_per_sec=timer.throughput(),
             )
             history.append(rec)
-
-            if val["ic"] > best_ic:
-                best_ic, best_epoch, bad_epochs = val["ic"], epoch, 0
-                if ckpt:
-                    ckpt.save(int(state.step), state._asdict(), wait=True)
-            else:
-                bad_epochs += 1
-                if bad_epochs >= cfg.optim.early_stop_patience:
-                    break
+            if harness.end_epoch(epoch, int(state.step), state._asdict(),
+                                 val["ic"]):
+                break
 
         # Restore best state for downstream prediction/backtest.
-        if ckpt and best_epoch >= 0:
-            restored = ckpt.restore(state._asdict())
-            state = TrainState(**restored)
-            ckpt.close()
+        best = harness.finalize(state._asdict())
+        if best is not None:
+            state = TrainState(**best)
         logger.close()
         self.state = state
         return {
-            "best_val_ic": best_ic,
-            "best_epoch": best_epoch,
-            "epochs_run": epoch + 1,
+            "best_val_ic": harness.best_ic,
+            "best_epoch": harness.best_epoch,
+            "epochs_run": harness.last_epoch + 1,
             "steps": int(state.step),
             "firm_months_per_sec": timer.throughput(),
             "history": history,
@@ -320,23 +437,31 @@ class Trainer:
         return out, out_valid
 
 
+def resolve_panel(d) -> Panel:
+    """DataConfig → Panel: saved .npz dir, CSV/parquet (Compustat-style
+    long format via data/compustat.py), or the synthetic generator."""
+    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
+
+    if d.panel_path:
+        if d.panel_path.endswith((".csv", ".parquet", ".pq")):
+            from lfm_quant_tpu.data.compustat import load_compustat_csv
+
+            return load_compustat_csv(d.panel_path, horizon=d.horizon)
+        return load_panel(d.panel_path)
+    return synthetic_panel(
+        n_firms=d.n_firms, n_months=d.n_months, n_features=d.n_features,
+        start_yyyymm=d.start_yyyymm, horizon=d.horizon, seed=d.panel_seed,
+    )
+
+
 def run_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
-                   echo: bool = False
+                   echo: bool = False, resume: bool = False
                    ) -> Tuple[Dict[str, Any], "Trainer", PanelSplits]:
     """Config → panel → splits → train; returns (summary, trainer, splits)
     — the train.py call stack, SURVEY.md §4.1."""
-    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
-
     d = cfg.data
     if panel is None:
-        if d.panel_path:
-            panel = load_panel(d.panel_path)
-        else:
-            panel = synthetic_panel(
-                n_firms=d.n_firms, n_months=d.n_months,
-                n_features=d.n_features, start_yyyymm=d.start_yyyymm,
-                horizon=d.horizon, seed=d.panel_seed,
-            )
+        panel = resolve_panel(d)
     dates = panel.dates
     train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
     val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
@@ -344,7 +469,7 @@ def run_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
 
     run_dir = os.path.join(cfg.out_dir, cfg.name, f"seed{cfg.seed}")
     trainer = Trainer(cfg, splits, run_dir=run_dir, echo=echo)
-    summary = trainer.fit()
+    summary = trainer.fit(resume=resume)
     summary["run_dir"] = run_dir
     summary["config"] = dataclasses.asdict(cfg)
     os.makedirs(run_dir, exist_ok=True)
@@ -359,27 +484,18 @@ def run_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
 def load_trainer(run_dir: str, panel: Optional[Panel] = None):
     """Rebuild a Trainer from a run directory and restore its best
     checkpoint (the backtest.py call stack, SURVEY.md §4.3)."""
-    from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
-
     with open(os.path.join(run_dir, "config.json")) as fh:
         cfg = RunConfig.from_json(fh.read())
     d = cfg.data
     if panel is None:
-        if d.panel_path:
-            panel = load_panel(d.panel_path)
-        else:
-            panel = synthetic_panel(
-                n_firms=d.n_firms, n_months=d.n_months,
-                n_features=d.n_features, start_yyyymm=d.start_yyyymm,
-                horizon=d.horizon, seed=d.panel_seed,
-            )
+        panel = resolve_panel(d)
     dates = panel.dates
     train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
     val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
     splits = PanelSplits.by_date(panel, train_end, val_end)
     trainer = Trainer(cfg, splits, run_dir=run_dir)
     state = trainer.init_state()
-    ckpt = CheckpointManager(os.path.join(run_dir, "ckpt"))
+    ckpt = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))
     restored = ckpt.restore(state._asdict())
     ckpt.close()
     trainer.state = TrainState(**restored)

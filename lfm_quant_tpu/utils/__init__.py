@@ -1,6 +1,13 @@
 """Utilities: throughput/profiling harness, structured metric logging."""
 
+from lfm_quant_tpu.utils.debug import assert_finite_tree, sanitized
 from lfm_quant_tpu.utils.logging import MetricsLogger
 from lfm_quant_tpu.utils.profiling import StepTimer, trace_context
 
-__all__ = ["MetricsLogger", "StepTimer", "trace_context"]
+__all__ = [
+    "MetricsLogger",
+    "StepTimer",
+    "trace_context",
+    "sanitized",
+    "assert_finite_tree",
+]

@@ -1,0 +1,45 @@
+"""Numerical sanitizers (SURVEY.md §6 "race detection / sanitizers" row).
+
+JAX's functional purity removes in-model data races by construction; the
+numerical failure modes that remain (NaN/Inf from bad losses, exploding
+grads, bf16 overflow) are caught by jax's debug-nans machinery plus chex
+shape/finiteness asserts at the step boundary. ``sanitized()`` is the CI
+mode: any NaN/Inf produced inside jit raises at the op that made it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def sanitized(nans: bool = True, infs: bool = True):
+    """Context manager enabling jax_debug_nans/_infs for the enclosed code.
+
+    Slows execution (disables some fusion; re-runs failing ops eagerly to
+    locate them) — CI/debug only, never in the benchmark path.
+    """
+    prev_nans = jax.config.jax_debug_nans
+    prev_infs = jax.config.jax_debug_infs
+    try:
+        jax.config.update("jax_debug_nans", nans)
+        jax.config.update("jax_debug_infs", infs)
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_debug_infs", prev_infs)
+
+
+def assert_finite_tree(tree, name: str = "tree"):
+    """Host-side finiteness check over a pytree (eval/test helper)."""
+    import numpy as np
+
+    bad = [
+        path
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+        if not np.all(np.isfinite(np.asarray(leaf)))
+    ]
+    if bad:
+        raise FloatingPointError(f"non-finite leaves in {name}: {bad}")

@@ -1,0 +1,56 @@
+"""Multi-host initialization (SURVEY.md §6 "distributed communication
+backend"): the reference scaled with per-GPU ``tf.distribute`` on one host;
+the TPU-native story is SPMD over every chip jax can see. Within one slice
+that needs nothing; across hosts/slices, each process calls
+``jax.distributed.initialize`` once at startup and ``jax.devices()`` then
+spans the pod — all mesh/sharding code in parallel/mesh.py is host-count
+agnostic by construction, and XLA routes collectives over ICI within a
+slice and DCN across slices.
+
+Configuration via environment (the launcher sets these per process):
+
+  LFM_COORDINATOR    — "host:port" of process 0.
+  LFM_NUM_PROCESSES  — total process count.
+  LFM_PROCESS_ID     — this process's rank.
+
+On managed TPU platforms (GKE/Cloud TPU) jax auto-detects these; calling
+``jax.distributed.initialize()`` with no args suffices, so an empty env is
+ALSO fine there — set LFM_AUTO_DISTRIBUTED=1 to opt in to argless init.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def maybe_initialize(env: Optional[dict] = None) -> bool:
+    """Initialize jax.distributed from the environment when configured.
+
+    Returns True if initialize() was called. Raises ValueError on a
+    partially-specified configuration (a silent single-host fallback on a
+    half-configured pod would train on 1/N of the data with no error).
+    """
+    env = os.environ if env is None else env
+    keys = ("LFM_COORDINATOR", "LFM_NUM_PROCESSES", "LFM_PROCESS_ID")
+    present = [k for k in keys if env.get(k)]
+    if env.get("LFM_AUTO_DISTRIBUTED"):
+        import jax
+
+        jax.distributed.initialize()
+        return True
+    if not present:
+        return False
+    if len(present) < len(keys):
+        missing = sorted(set(keys) - set(present))
+        raise ValueError(
+            f"partial multi-host config: {present} set but {missing} "
+            "missing — refusing to guess")
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=env["LFM_COORDINATOR"],
+        num_processes=int(env["LFM_NUM_PROCESSES"]),
+        process_id=int(env["LFM_PROCESS_ID"]),
+    )
+    return True

@@ -1,0 +1,227 @@
+"""Real-data loader tests: long-format CSV → Panel with per-month
+standardization, target alignment, and return-convention conversion."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from lfm_quant_tpu.data.compustat import (
+    load_compustat_csv,
+    to_long_frame,
+)
+from lfm_quant_tpu.data.panel import synthetic_panel
+
+
+def make_csv(tmp_path, n=40, t=60, f=3, seed=0, gaps=True):
+    """Hand-built long-format fixture with known raw values."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    y0, m0 = 1990, 1
+    months = [(y0 + (m0 + k - 1) // 12) * 100 + ((m0 + k - 1) % 12 + 1)
+              for k in range(t)]
+    for g in range(1, n + 1):
+        start = int(rng.integers(0, t // 4)) if gaps else 0
+        for j in range(start, t):
+            if gaps and rng.random() < 0.02:
+                continue
+            rows.append({
+                "gvkey": g,
+                "yyyymm": months[j],
+                "ebit_ev": rng.normal(loc=g * 0.01, scale=1.0),
+                "bm": rng.normal(),
+                "mom": rng.normal(),
+                "ret": rng.normal() * 0.05,
+            })
+    path = str(tmp_path / "panel.csv")
+    pd.DataFrame(rows).to_csv(path, index=False)
+    return path, months
+
+
+def test_load_shapes_and_masks(tmp_path):
+    path, months = make_csv(tmp_path)
+    p = load_compustat_csv(path, horizon=6)
+    assert p.n_firms == 40
+    assert p.n_months == 60
+    assert p.feature_names == ["ebit_ev", "bm", "mom"]
+    assert list(p.dates) == months
+    p.validate()
+
+
+def test_per_month_standardization(tmp_path):
+    path, _ = make_csv(tmp_path)
+    p = load_compustat_csv(path, horizon=6)
+    for j in (5, 30, 55):
+        sel = p.valid[:, j]
+        if sel.sum() < 5:
+            continue
+        x = p.features[sel, j, :]
+        np.testing.assert_allclose(x.mean(axis=0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(x.std(axis=0), 1.0, atol=1e-3)
+
+
+def test_winsorization_tames_outliers(tmp_path):
+    rng = np.random.default_rng(1)
+    rows = []
+    for g in range(1, 101):
+        rows.append({"gvkey": g, "yyyymm": 200001,
+                     "ebit_ev": 1e6 if g == 1 else rng.normal(),
+                     "ret": 0.0})
+    path = str(tmp_path / "w.csv")
+    pd.DataFrame(rows).to_csv(path, index=False)
+    p = load_compustat_csv(path, horizon=1, winsor=(0.01, 0.99))
+    # The 1e6 outlier must be clipped to the 99th pct before z-scoring.
+    assert abs(p.features[0, 0, 0]) < 5.0
+
+
+def test_target_is_future_standardized_value(tmp_path):
+    path, _ = make_csv(tmp_path, gaps=False)
+    h = 6
+    p = load_compustat_csv(path, target_col="ebit_ev", horizon=h)
+    k = p.feature_names.index("ebit_ev")
+    tv = p.target_valid
+    # target[i, t] == standardized feature at t+h wherever both ends valid.
+    np.testing.assert_allclose(
+        p.targets[:, :-h][tv[:, :-h]],
+        p.features[:, h:, k][tv[:, :-h]],
+        atol=1e-6,
+    )
+    assert not tv[:, -h:].any()
+
+
+def test_return_convention_conversion(tmp_path):
+    """File carries trailing returns; Panel.returns[t] must be the forward
+    return (the file's row at t+1)."""
+    rows = []
+    vals = [0.01, 0.02, 0.03, 0.04]
+    for j, (m, r) in enumerate(zip([200001, 200002, 200003, 200004], vals)):
+        for g in (1, 2, 3, 4, 5):
+            rows.append({"gvkey": g, "yyyymm": m, "ebit_ev": g * 0.1 + j,
+                         "ret": r if g == 1 else 0.0})
+    path = str(tmp_path / "r.csv")
+    pd.DataFrame(rows).to_csv(path, index=False)
+    p = load_compustat_csv(path, horizon=1)
+    np.testing.assert_allclose(p.returns[0, :3], [0.02, 0.03, 0.04], atol=1e-6)
+    assert p.returns[0, 3] == 0.0  # no forward month
+
+
+def test_missing_months_invalid(tmp_path):
+    path, months = make_csv(tmp_path, gaps=True)
+    df = pd.read_csv(path)
+    p = load_compustat_csv(path, horizon=6)
+    present = set(zip(df["gvkey"], df["yyyymm"]))
+    fpos = {g: i for i, g in enumerate(p.firm_ids)}
+    dpos = {d: j for j, d in enumerate(p.dates)}
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        g = int(rng.integers(1, 41))
+        m = months[int(rng.integers(0, len(months)))]
+        assert p.valid[fpos[g], dpos[m]] == ((g, m) in present)
+
+
+def test_delisting_excluded_from_universe(tmp_path):
+    """A firm with features at t but no t+1 row must be flagged
+    ret_valid=False at t and excluded by the backtest universe — never
+    credited a fabricated 0% return (delisting bias)."""
+    rows = []
+    for g in range(1, 31):
+        last = 200004 if g == 1 else 200006  # firm 1 delists after April
+        for m in [200001, 200002, 200003, 200004, 200005, 200006]:
+            if m > last:
+                continue
+            rows.append({"gvkey": g, "yyyymm": m, "ebit_ev": g * 0.1 + m % 7,
+                         "ret": 0.01})
+    path = str(tmp_path / "dl.csv")
+    pd.DataFrame(rows).to_csv(path, index=False)
+    p = load_compustat_csv(path, horizon=1)
+    i = list(p.firm_ids).index(1)
+    assert p.valid[i, 3]           # April features exist
+    assert not p.ret_valid[i, 3]   # but April's forward return is unobserved
+    assert not p.tradeable()[i, 3]
+    assert p.tradeable()[i, 2]     # March still tradeable (April row exists)
+
+    from lfm_quant_tpu.backtest import run_backtest
+    fc = np.tile(np.linspace(1, 0, 30)[:, None], (1, 6)).astype(np.float32)
+    rep = run_backtest(fc, np.ones_like(p.valid), p, quantile=0.1,
+                       min_universe=5)
+    assert rep.n_months > 0  # engine consumed the masked panel cleanly
+
+
+def test_duplicate_rows_rejected(tmp_path):
+    rows = [{"gvkey": 1, "yyyymm": 200001, "ebit_ev": 1.0, "ret": 0.0}] * 2
+    path = str(tmp_path / "d.csv")
+    pd.DataFrame(rows).to_csv(path, index=False)
+    with pytest.raises(ValueError, match="duplicate"):
+        load_compustat_csv(path)
+
+
+def test_missing_required_columns(tmp_path):
+    path = str(tmp_path / "m.csv")
+    pd.DataFrame([{"firm": 1, "month": 200001}]).to_csv(path, index=False)
+    with pytest.raises(ValueError, match="gvkey"):
+        load_compustat_csv(path)
+
+
+def test_bad_target_col(tmp_path):
+    path, _ = make_csv(tmp_path)
+    with pytest.raises(ValueError, match="target_col"):
+        load_compustat_csv(path, target_col="nonexistent")
+
+
+def test_roundtrip_through_long_frame(tmp_path):
+    """Panel → long frame → CSV → loader reproduces masks and date grid
+    (values get re-standardized, so compare structure + rank order)."""
+    p0 = synthetic_panel(n_firms=60, n_months=100, n_features=3, seed=9)
+    df = to_long_frame(p0)
+    path = str(tmp_path / "rt.csv")
+    df.to_csv(path, index=False)
+    p1 = load_compustat_csv(path, horizon=p0.horizon, winsor=None)
+    assert p1.n_months == p0.n_months
+    np.testing.assert_array_equal(p1.dates, p0.dates)
+    # Months with a cross-section below the loader's min_cross_section are
+    # invalidated by policy (degenerate z-scores); compare the rest.
+    ok = p0.valid.sum(axis=0) >= 5
+    assert ok.sum() > 90
+    np.testing.assert_array_equal(p1.valid[:, ok], p0.valid[:, ok])
+    assert not p1.valid[:, ~ok].any()
+    # Cross-sectional rank order of feature 0 preserved by z-scoring.
+    j = 50
+    sel = p0.valid[:, j]
+    a = p0.features[sel, j, 0]
+    b = p1.features[:, j, 0][p1.valid[:, j]]
+    assert np.array_equal(np.argsort(a), np.argsort(b))
+
+
+def test_csv_reachable_from_config(tmp_path):
+    """A CSV panel_path in the config must route through the CSV loader
+    (the train.py surface for real data)."""
+    from lfm_quant_tpu.config import DataConfig
+    from lfm_quant_tpu.train.loop import resolve_panel
+
+    path, _ = make_csv(tmp_path)
+    p = resolve_panel(DataConfig(panel_path=path, horizon=6))
+    assert p.feature_names == ["ebit_ev", "bm", "mom"]
+    assert p.n_firms == 40
+
+
+def test_train_on_loaded_panel(tmp_path):
+    """End-to-end: loader output trains through the standard pipeline."""
+    from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+    from lfm_quant_tpu.data import PanelSplits
+    from lfm_quant_tpu.train import Trainer
+
+    p0 = synthetic_panel(n_firms=100, n_months=140, n_features=3, seed=10)
+    df = to_long_frame(p0)
+    path = str(tmp_path / "t.csv")
+    df.to_csv(path, index=False)
+    panel = load_compustat_csv(path, horizon=12, winsor=None)
+    splits = PanelSplits.by_date(panel, 197808, 198001)
+    cfg = RunConfig(
+        name="csv",
+        data=DataConfig(window=12, dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=3e-3, epochs=2, warmup_steps=5, loss="mse"),
+        out_dir=str(tmp_path),
+    )
+    t = Trainer(cfg, splits)
+    summary = t.fit()
+    assert np.isfinite(summary["history"][-1]["train_loss"])

@@ -1,0 +1,22 @@
+"""Multi-host init gating logic (the initialize() call itself needs a real
+pod; CI validates the configuration contract)."""
+
+import pytest
+
+from lfm_quant_tpu.utils.distributed import maybe_initialize
+
+
+def test_empty_env_is_noop():
+    assert maybe_initialize(env={}) is False
+
+
+def test_partial_config_refuses():
+    with pytest.raises(ValueError, match="partial multi-host config"):
+        maybe_initialize(env={"LFM_COORDINATOR": "host:1234"})
+    with pytest.raises(ValueError, match="LFM_PROCESS_ID"):
+        maybe_initialize(env={"LFM_COORDINATOR": "host:1234",
+                              "LFM_NUM_PROCESSES": "4"})
+
+
+def test_unrelated_env_ignored():
+    assert maybe_initialize(env={"PATH": "/bin", "LFM_OTHER": "x"}) is False

@@ -1,0 +1,164 @@
+"""Failure recovery, sanitizer, and profiler subsystems (SURVEY.md §6)."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+from lfm_quant_tpu.train import Trainer
+from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+from lfm_quant_tpu.utils import StepTimer, sanitized, trace_context
+from lfm_quant_tpu.utils.debug import assert_finite_tree
+
+
+def cfg_for(tmp, epochs, patience=99, n_seeds=1):
+    return RunConfig(
+        name="rec",
+        data=DataConfig(n_firms=150, n_months=150, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=2e-3, epochs=epochs, warmup_steps=5,
+                          early_stop_patience=patience, loss="mse"),
+        seed=0,
+        n_seeds=n_seeds,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=150, n_months=150, n_features=5, seed=41)
+
+
+@pytest.fixture(scope="module")
+def splits(panel):
+    return PanelSplits.by_date(panel, 197910, 198101)
+
+
+def test_resume_continues_from_crash(panel, splits, tmp_path):
+    """Simulated preemption: 2 epochs, 'crash', resume to 5 — the resumed
+    run continues at epoch 2 and ends with 5 epochs of metrics."""
+    run_dir = str(tmp_path / "run")
+    t1 = Trainer(cfg_for(tmp_path, epochs=2), splits, run_dir=run_dir)
+    t1.fit()
+    prog = json.load(open(os.path.join(run_dir, "fit_progress.json")))
+    assert prog["epoch"] == 1
+
+    t2 = Trainer(cfg_for(tmp_path, epochs=5), splits, run_dir=run_dir)
+    summary = t2.fit(resume=True)
+    assert summary["history"][0]["epoch"] == 2
+    assert summary["history"][-1]["epoch"] == 4
+    lines = [json.loads(l) for l in open(os.path.join(run_dir, "metrics.jsonl"))]
+    assert [l["epoch"] for l in lines] == [0, 1, 2, 3, 4]
+    # step counter carried through the crash (no restart from 0).
+    assert lines[2]["step"] > lines[1]["step"]
+
+
+def test_resume_with_no_checkpoint_starts_fresh(splits, tmp_path):
+    run_dir = str(tmp_path / "fresh")
+    t = Trainer(cfg_for(tmp_path, epochs=2), splits, run_dir=run_dir)
+    summary = t.fit(resume=True)
+    assert summary["history"][0]["epoch"] == 0
+
+
+def test_resume_past_end_is_noop(splits, tmp_path):
+    run_dir = str(tmp_path / "done")
+    t1 = Trainer(cfg_for(tmp_path, epochs=3), splits, run_dir=run_dir)
+    t1.fit()
+    t2 = Trainer(cfg_for(tmp_path, epochs=3), splits, run_dir=run_dir)
+    summary = t2.fit(resume=True)
+    assert summary["history"] == []
+    assert summary["epochs_run"] == 3  # reported from the completed run
+
+
+def test_resume_after_early_stop_does_not_restart(splits, tmp_path):
+    """A run that ended via early stopping must not train further on
+    --resume (an automatic retry wrapper would otherwise change results)."""
+    run_dir = str(tmp_path / "es")
+    t1 = Trainer(cfg_for(tmp_path, epochs=10, patience=2), splits,
+                 run_dir=run_dir)
+    t1.cfg.optim.lr = 0.0  # no improvement after epoch 0 → stops at 3
+    s1 = t1.fit()
+    assert s1["epochs_run"] < 10
+    t2 = Trainer(cfg_for(tmp_path, epochs=10, patience=2), splits,
+                 run_dir=run_dir)
+    s2 = t2.fit(resume=True)
+    assert s2["history"] == [], "early-stopped run must stay stopped"
+
+
+def test_resume_with_corrupt_sidecar_degrades_gracefully(splits, tmp_path):
+    """A crash inside the persist window can corrupt fit_progress.json;
+    resume must fall back to checkpoint-derived counters, not die."""
+    run_dir = str(tmp_path / "corrupt")
+    t1 = Trainer(cfg_for(tmp_path, epochs=2), splits, run_dir=run_dir)
+    t1.fit()
+    with open(os.path.join(run_dir, "fit_progress.json"), "w") as fh:
+        fh.write('{"epoch": 1, "best_')  # truncated mid-dump
+    t2 = Trainer(cfg_for(tmp_path, epochs=3), splits, run_dir=run_dir)
+    summary = t2.fit(resume=True)
+    assert summary["history"][0]["epoch"] == 2  # derived from ckpt step
+    assert summary["history"][-1]["epoch"] == 2
+
+
+def test_best_checkpoint_separate_from_latest(splits, tmp_path):
+    run_dir = str(tmp_path / "bl")
+    t = Trainer(cfg_for(tmp_path, epochs=3), splits, run_dir=run_dir)
+    t.fit()
+    assert glob.glob(os.path.join(run_dir, "ckpt", "latest", "*"))
+    assert glob.glob(os.path.join(run_dir, "ckpt", "best", "*"))
+
+
+def test_ensemble_resume(panel, splits, tmp_path):
+    run_dir = str(tmp_path / "ens")
+    e1 = EnsembleTrainer(cfg_for(tmp_path, epochs=2, n_seeds=2), splits,
+                         run_dir=run_dir)
+    e1.fit()
+    e2 = EnsembleTrainer(cfg_for(tmp_path, epochs=4, n_seeds=2), splits,
+                         run_dir=run_dir)
+    summary = e2.fit(resume=True)
+    assert summary["history"][0]["epoch"] == 2
+    assert summary["history"][-1]["epoch"] == 3
+
+
+def test_zero_epochs_rejected(splits, tmp_path):
+    t = Trainer(cfg_for(tmp_path, epochs=1), splits)
+    t.cfg.optim.epochs = 0
+    with pytest.raises(ValueError, match="epochs"):
+        t.fit()
+
+
+def test_sanitized_raises_on_nan():
+    with sanitized():
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.asarray(-1.0)).block_until_ready()
+    # and restores config afterwards
+    assert not jax.config.jax_debug_nans
+
+
+def test_assert_finite_tree():
+    assert_finite_tree({"a": jnp.ones(3)}, "ok")
+    with pytest.raises(FloatingPointError, match="bad"):
+        assert_finite_tree({"x": jnp.asarray([1.0, np.nan])}, "bad")
+
+
+def test_trace_context_writes_profile(tmp_path):
+    d = str(tmp_path / "prof")
+    with trace_context(d):
+        jax.jit(lambda x: x * 2)(jnp.ones(64)).block_until_ready()
+    files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), "no trace written"
+
+
+def test_step_timer_accounting():
+    t = StepTimer()
+    t.start()
+    x = jnp.ones(8) + 1
+    t.stop(x, firm_months=100.0)
+    assert t.steps == 1 and t.firm_months == 100.0
+    assert t.throughput() > 0

@@ -32,6 +32,12 @@ def main(argv=None) -> int:
     ap.add_argument("--echo", action="store_true", help="print metrics lines")
     ap.add_argument("--scale", type=float, default=None,
                     help="shrink the synthetic panel by this factor (smoke runs)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the latest checkpoint in the run dir")
+    ap.add_argument("--debug", action="store_true",
+                    help="sanitizer mode: raise on any NaN/Inf inside jit")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="write a jax.profiler trace (Perfetto) to DIR")
     args = ap.parse_args(argv)
 
     # Import late so --help works instantly without initializing JAX.
@@ -64,12 +70,26 @@ def main(argv=None) -> int:
                          int(d.n_months * args.scale)),
         ))
 
-    if cfg.n_seeds > 1:
-        from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
-        summary, _, _ = run_ensemble_experiment(cfg, echo=args.echo)
-    else:
-        from lfm_quant_tpu.train.loop import run_experiment
-        summary, _, _ = run_experiment(cfg, echo=args.echo)
+    import contextlib
+
+    from lfm_quant_tpu.utils import sanitized, trace_context
+    from lfm_quant_tpu.utils.distributed import maybe_initialize
+
+    maybe_initialize()  # multi-host pods; no-op on a single host
+
+    ctx = contextlib.ExitStack()
+    with ctx:
+        if args.debug:
+            ctx.enter_context(sanitized())
+        ctx.enter_context(trace_context(args.profile))
+        if cfg.n_seeds > 1:
+            from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
+            summary, _, _ = run_ensemble_experiment(
+                cfg, echo=args.echo, resume=args.resume)
+        else:
+            from lfm_quant_tpu.train.loop import run_experiment
+            summary, _, _ = run_experiment(
+                cfg, echo=args.echo, resume=args.resume)
     print(json.dumps({k: v for k, v in summary.items() if k != "history"},
                      indent=2, default=str))
     return 0
