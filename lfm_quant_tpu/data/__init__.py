@@ -8,6 +8,7 @@ from lfm_quant_tpu.data.windows import (
     device_panel,
     gather_targets,
     gather_windows,
+    gather_windows_packed,
 )
 
 __all__ = [
@@ -21,4 +22,5 @@ __all__ = [
     "device_panel",
     "gather_targets",
     "gather_windows",
+    "gather_windows_packed",
 ]

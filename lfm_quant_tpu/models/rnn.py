@@ -3,12 +3,18 @@
 
 TPU-first design:
 
-* Each cell step is ONE fused gate matmul ``[x, h] @ W → 4H (LSTM) / 3H
-  (GRU)`` so the MXU sees a single large GEMM per step instead of eight
-  small ones (the GRU needs a second small matmul for the candidate because
-  the reset gate is applied to ``h`` *before* its projection).
+* **Hoisted input projection** (the cuDNN RNN decomposition): the input
+  contribution to every gate, ``x_t @ W_x + b`` for all T steps, is ONE
+  large ``[B, T, H] @ [H, gates·H]`` GEMM computed outside the scan — a
+  shape the MXU tiles perfectly. Only the irreducibly-serial recurrent
+  matmul ``h @ W_h`` stays inside the scan, so the serial critical path
+  does half the matmul work of a fused ``[x, h]`` cell.
 * The time axis is driven by ``lax.scan`` via ``nn.scan`` (prescribed at
   BASELINE.json:5) — compiled once, no Python unrolling.
+* The GRU uses the reset-after-projection (cuDNN v2) variant,
+  ``n = tanh(x·Wxn + r ⊙ (h·Whn))``, precisely because it lets the x-side
+  of all three gates hoist out of the scan; the classic v1 variant
+  (reset-before-projection) would force a second in-scan matmul.
 * Masking: invalid months HOLD the carried state (h, c unchanged), so a
   firm's forecast is a function of its valid history only; with left-padded
   short histories the initial zero state simply persists until the first
@@ -26,12 +32,13 @@ import jax.numpy as jnp
 from lfm_quant_tpu.models.heads import ForecastHead
 
 
-class LSTMCellFused(nn.Module):
-    """LSTM cell with a single fused ifgo matmul and state-hold masking.
+class LSTMRecurrence(nn.Module):
+    """Recurrent-only LSTM step (input contribution precomputed).
 
-    carry = (h, c), input = (x_t, m_t) where m_t carries a trailing
-    singleton dim ([..., 1]) so the scan treats x and m uniformly on axis -2;
-    returns h_t as the per-step output.
+    carry = (h, c); input = (xw_t, m_t) where ``xw_t = x_t @ W_x + b`` is
+    the hoisted [..., 4H] ifgo input projection and m_t carries a trailing
+    singleton dim ([..., 1]) so the scan treats xw and m uniformly on
+    axis -2; returns h_t as the per-step output.
     """
 
     hidden: int
@@ -39,12 +46,12 @@ class LSTMCellFused(nn.Module):
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, carry, xm):
+    def __call__(self, carry, inp):
         h, c = carry
-        x, m = xm
-        x = x.astype(h.dtype)
-        z = jnp.concatenate([x, h], axis=-1)
-        gates = nn.Dense(4 * self.hidden, dtype=self.dtype, name="ifgo")(z)
+        xw, m = inp
+        gates = xw.astype(h.dtype) + nn.Dense(
+            4 * self.hidden, use_bias=False, dtype=self.dtype, name="h_proj"
+        )(h)
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         c_new = nn.sigmoid(f + self.forget_bias) * c + nn.sigmoid(i) * jnp.tanh(g)
         h_new = nn.sigmoid(o) * jnp.tanh(c_new)
@@ -54,38 +61,41 @@ class LSTMCellFused(nn.Module):
         return (h, c), h
 
 
-class GRUCellFused(nn.Module):
-    """GRU cell: fused z/r matmul + candidate matmul, state-hold masking."""
+class GRURecurrence(nn.Module):
+    """Recurrent-only GRU step, reset-after-projection (cuDNN v2) variant."""
 
     hidden: int
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
-    def __call__(self, carry, xm):
+    def __call__(self, carry, inp):
         (h,) = carry
-        x, m = xm
-        x = x.astype(h.dtype)
-        zin = jnp.concatenate([x, h], axis=-1)
-        zr = nn.Dense(2 * self.hidden, dtype=self.dtype, name="zr")(zin)
-        z, r = jnp.split(zr, 2, axis=-1)
-        z, r = nn.sigmoid(z), nn.sigmoid(r)
-        cand_in = jnp.concatenate([x, r * h], axis=-1)
-        n = jnp.tanh(nn.Dense(self.hidden, dtype=self.dtype, name="cand")(cand_in))
+        xw, m = inp
+        hw = nn.Dense(
+            3 * self.hidden, use_bias=False, dtype=self.dtype, name="h_proj"
+        )(h)
+        xz, xr, xn = jnp.split(xw.astype(h.dtype), 3, axis=-1)
+        hz, hr, hn = jnp.split(hw, 3, axis=-1)
+        z = nn.sigmoid(xz + hz)
+        r = nn.sigmoid(xr + hr)
+        n = jnp.tanh(xn + r * hn)
         h_new = (1.0 - z) * n + z * h
         keep = m.astype(h.dtype)
         h = keep * h_new + (1.0 - keep) * h
         return (h,), h
 
 
-_CELLS = {"lstm": LSTMCellFused, "gru": GRUCellFused}
+# cell name → (recurrence module, gate multiplier, carry arity)
+_CELLS = {"lstm": (LSTMRecurrence, 4, 2), "gru": (GRURecurrence, 3, 1)}
 
 
 class RNNModel(nn.Module):
     """Stacked masked RNN over the lookback window → forecast head.
 
-    ``cell``: "lstm" | "gru".  Input projection lifts F → hidden once so
-    every scan step's fused matmul is (hidden + hidden) × gates — a square,
-    MXU-friendly shape even when F is tiny (5–20 in the ladder configs).
+    ``cell``: "lstm" | "gru".  Input projection lifts F → hidden once; each
+    layer then hoists its gate input projection (``gates·H`` wide) out of
+    the scan as a single big GEMM, leaving one ``[.., H] @ [H, gates·H]``
+    matmul on the serial path per step.
     """
 
     cell: str = "lstm"
@@ -99,6 +109,7 @@ class RNNModel(nn.Module):
     def __call__(self, x, m, deterministic: bool = True):
         if self.cell not in _CELLS:
             raise ValueError(f"cell must be one of {sorted(_CELLS)}")
+        rec_cls, gate_mult, carry_n = _CELLS[self.cell]
         compute_dtype = self.dtype or jnp.float32
         batch_shape = x.shape[:-2]
         h = nn.Dense(self.hidden, dtype=self.dtype, name="embed")(
@@ -106,17 +117,21 @@ class RNNModel(nn.Module):
         )
         mexp = m[..., None].astype(compute_dtype)  # [..., W, 1]: scan axis -2
         zeros = jnp.zeros((*batch_shape, self.hidden), compute_dtype)
-        cell_cls = _CELLS[self.cell]
         for layer in range(self.layers):
+            # Hoisted input projection: all T steps in one GEMM.
+            xw = nn.Dense(
+                gate_mult * self.hidden, dtype=self.dtype,
+                name=f"{self.cell}_{layer}_xproj",
+            )(h)
             scan = nn.scan(
-                cell_cls,
+                rec_cls,
                 variable_broadcast="params",
                 split_rngs={"params": False},
-                in_axes=-2,   # time axis of (x, m) inputs
+                in_axes=-2,   # time axis of (xw, m) inputs
                 out_axes=-2,
             )(hidden=self.hidden, dtype=self.dtype, name=f"{self.cell}_{layer}")
-            carry = (zeros, zeros) if self.cell == "lstm" else (zeros,)
-            _, h = scan(carry, (h, mexp))
+            carry = (zeros,) * carry_n
+            _, h = scan(carry, (xw, mexp))
         # Masked steps held state, so the last step's output is the state at
         # the last *valid* month.
         z = h[..., -1, :]

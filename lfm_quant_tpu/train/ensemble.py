@@ -85,7 +85,9 @@ class EnsembleTrainer:
         # ONE HBM-resident panel serves the ensemble and the inner trainer
         # (PanelSplits are anchor ranges over a shared panel, not slices).
         self.dev = device_panel(
-            splits.panel, replicated(self.mesh) if self.mesh else None)
+            splits.panel, replicated(self.mesh) if self.mesh else None,
+            compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
+            raw=False)
         self.inner.dev = self.dev
 
         d = cfg.data
@@ -121,10 +123,16 @@ class EnsembleTrainer:
     def init_state(self) -> TrainState:
         keys = jax.random.split(jax.random.key(self.cfg.seed), self.n_seeds)
         state = jax.vmap(self.inner.init_state)(keys)
-        if self.mesh is not None:
-            shardings = state_sharding(self.mesh, state, stacked=True)
-            state = jax.device_put(state, shardings)
-        return state
+        return self._commit_state(state)
+
+    def _commit_state(self, state: TrainState) -> TrainState:
+        """Place a stacked state on the mesh (seed axis sharded). Needed
+        after Orbax restores, whose arrays arrive committed to one device
+        and would conflict with the mesh-placed panel inside jit."""
+        if self.mesh is None:
+            return state
+        shardings = state_sharding(self.mesh, state, stacked=True)
+        return jax.device_put(state, shardings)
 
     def _stacked_batch(self, iterators) -> Optional[Tuple]:
         """Stack one [S, D, Bf] index batch from the per-seed samplers."""
@@ -182,7 +190,7 @@ class EnsembleTrainer:
         if resume:
             restored = harness.resume(state._asdict())
             if restored is not None:
-                state = TrainState(**restored)
+                state = self._commit_state(TrainState(**restored))
         logger = MetricsLogger(self.run_dir, echo=self.echo)
         timer = StepTimer()
 
@@ -213,7 +221,7 @@ class EnsembleTrainer:
 
         best = harness.finalize(state._asdict())
         if best is not None:
-            state = TrainState(**best)
+            state = self._commit_state(TrainState(**best))
         logger.close()
         self.state = state
         return {
@@ -300,5 +308,5 @@ def load_ensemble(run_dir: str, panel: Optional[Panel] = None):
     ckpt = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))
     restored = ckpt.restore(state._asdict())
     ckpt.close()
-    trainer.state = TrainState(**restored)
+    trainer.state = trainer._commit_state(TrainState(**restored))
     return trainer, splits

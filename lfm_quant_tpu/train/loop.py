@@ -32,7 +32,7 @@ from lfm_quant_tpu.data.windows import (
     WindowIndex,
     device_panel,
     gather_targets,
-    gather_windows,
+    gather_windows_packed,
 )
 from lfm_quant_tpu.models import build_model
 from lfm_quant_tpu.parallel import make_mesh, replicated, shard_batch
@@ -240,7 +240,10 @@ class Trainer:
             # ONE device-resident copy of the full panel serves training,
             # eval and inference (PanelSplits are anchor ranges, not slices).
             panel_sharding = replicated(self.mesh) if self.mesh else None
-            self.dev = device_panel(splits.panel, panel_sharding)
+            self.dev = device_panel(
+                splits.panel, panel_sharding,
+                compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
+                raw=False)
         else:
             self.dev = None
 
@@ -274,8 +277,8 @@ class Trainer:
     def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
                    weight):
         def loss_of(params):
-            x, m = gather_windows(
-                dev["features"], dev["valid"], firm_idx, time_idx, self.window
+            x, m = gather_windows_packed(
+                dev["xm"], firm_idx, time_idx, self.window
             )
             y = gather_targets(dev["targets"], firm_idx, time_idx)
             out = self._apply(params, x, m)
@@ -300,17 +303,50 @@ class Trainer:
         return jax.lax.scan(body, state, (fi, ti, w))
 
     def _forward_impl(self, params, dev: dict, firm_idx, time_idx, weight):
-        """Eval forward: returns (pred [D,Bf], per-month IC [D], mse scalar)."""
-        x, m = gather_windows(
-            dev["features"], dev["valid"], firm_idx, time_idx, self.window
-        )
-        y = gather_targets(dev["targets"], firm_idx, time_idx)
-        pred = _point_forecast(self._apply(params, x, m))
-        ic = spearman_ic(pred, y, weight)
-        mse = masked_mse(pred, y, weight)
+        """Eval forward: returns (pred [D,Bf], per-month IC [D], mse scalar).
+
+        Chunked over the date axis with ``lax.map``: eval sweeps stack ALL
+        months into one [M, bf] batch, and the fast gather materializes
+        full firm histories ([chunk, bf, T, F]) — unchunked that would be
+        T/W × the window bytes for every eval month at once.
+        """
+        M = firm_idx.shape[0]
+        C = min(self.cfg.data.dates_per_batch, M)
+        pad = (-M) % C
+        if pad:
+            firm_idx = jnp.concatenate([firm_idx, firm_idx[:pad]], axis=0)
+            time_idx = jnp.concatenate([time_idx, time_idx[:pad]], axis=0)
+            weight = jnp.concatenate(
+                [weight, jnp.zeros_like(weight[:pad])], axis=0)
+        nc = firm_idx.shape[0] // C
+        chunks = (firm_idx.reshape(nc, C, -1), time_idx.reshape(nc, C),
+                  weight.reshape(nc, C, -1))
+
+        def chunk(args):
+            fi, ti, w = args
+            x, m = gather_windows_packed(dev["xm"], fi, ti, self.window)
+            y = gather_targets(dev["targets"], fi, ti)
+            pred = _point_forecast(self._apply(params, x, m))
+            ic = spearman_ic(pred, y, w)
+            se = (w * (pred.astype(jnp.float32) - y) ** 2).sum(axis=-1)
+            return pred, ic, se, w.sum(axis=-1)
+
+        pred, ic, se, ws = jax.lax.map(chunk, chunks)
+        pred = pred.reshape(nc * C, -1)[:M]
+        ic = ic.reshape(-1)[:M]
+        se, ws = se.reshape(-1)[:M], ws.reshape(-1)[:M]
+        mse = se.sum() / jnp.maximum(ws.sum(), 1e-12)
         return pred, ic, mse
 
     # ---- public API --------------------------------------------------
+
+    def _commit_state(self, state: TrainState) -> TrainState:
+        """Re-place a state on the data-parallel mesh (replicated). Needed
+        after an Orbax restore: restored arrays arrive committed to one
+        device, which conflicts with the mesh-replicated panel inside jit."""
+        if self.mesh is None:
+            return state
+        return jax.device_put(state, replicated(self.mesh))
 
     def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
         if rng is None:
@@ -362,7 +398,7 @@ class Trainer:
         if resume:
             restored = harness.resume(state._asdict())
             if restored is not None:
-                state = TrainState(**restored)
+                state = self._commit_state(TrainState(**restored))
         logger = MetricsLogger(self.run_dir, echo=self.echo)
         timer = StepTimer()
 
@@ -397,7 +433,7 @@ class Trainer:
         # Restore best state for downstream prediction/backtest.
         best = harness.finalize(state._asdict())
         if best is not None:
-            state = TrainState(**best)
+            state = self._commit_state(TrainState(**best))
         logger.close()
         self.state = state
         return {
@@ -498,5 +534,5 @@ def load_trainer(run_dir: str, panel: Optional[Panel] = None):
     ckpt = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))
     restored = ckpt.restore(state._asdict())
     ckpt.close()
-    trainer.state = TrainState(**restored)
+    trainer.state = trainer._commit_state(TrainState(**restored))
     return trainer, splits

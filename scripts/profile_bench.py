@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Ad-hoc profiling of the c2 bench step: where does the time go?
+
+Compares wall-clock of variants on the real chip:
+  full      — train step (fwd+bwd+optax) as bench.py runs it
+  fwd       — forward+loss only
+  fwd_model — forward without gather (pre-gathered windows)
+  gather    — window gather only
+Also sweeps batch geometry to test latency- vs throughput-bound.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lfm_quant_tpu.config import get_preset
+from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+from lfm_quant_tpu.train import Trainer
+import dataclasses as dc
+
+
+def timeit(fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    # force real sync via readback
+    _ = np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _ = np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    cfg = get_preset("c2")
+    d = cfg.data
+    panel = synthetic_panel(n_firms=d.n_firms, n_months=240,
+                            n_features=d.n_features, horizon=d.horizon, seed=0)
+    splits = PanelSplits.by_date(panel, 198601, 198801)
+    trainer = Trainer(cfg, splits)
+    state = trainer.init_state()
+
+    b = trainer.train_sampler.stacked_epoch(0)
+    k = min(30, b.firm_idx.shape[0])
+    b = dc.replace(b, firm_idx=b.firm_idx[:k], time_idx=b.time_idx[:k],
+                   weight=b.weight[:k])
+    fi, ti, w = trainer._batch_args(b, train=True, steps=True)
+    fm = float(b.weight.sum()) * trainer.window
+
+    t_full = timeit(lambda: trainer._jit_multi_step(state, trainer.dev, fi, ti, w))
+    print(f"full multi-step ({k} steps): {t_full*1e3:.1f} ms  "
+          f"-> {fm/t_full/1e6:.1f} M fm/s")
+
+    # forward only, scanned over the same steps
+    from lfm_quant_tpu.data.windows import gather_windows, gather_targets
+
+    @jax.jit
+    def fwd_scan(params, dev, fi, ti, w):
+        def body(c, batch):
+            bfi, bti, bw = batch
+            x, m = gather_windows(dev["features"], dev["valid"], bfi, bti,
+                                  trainer.window)
+            y = gather_targets(dev["targets"], bfi, bti)
+            out = trainer._apply(params, x, m)
+            return c, trainer.loss_fn(out, y, bw)
+        return jax.lax.scan(body, 0, (fi, ti, w))
+
+    t_fwd = timeit(lambda: fwd_scan(state.params, trainer.dev, fi, ti, w))
+    print(f"fwd+loss scan: {t_fwd*1e3:.1f} ms ({t_fwd/t_full*100:.0f}% of full)")
+
+    @jax.jit
+    def gather_scan(dev, fi, ti, w):
+        def body(c, batch):
+            bfi, bti, bw = batch
+            x, m = gather_windows(dev["features"], dev["valid"], bfi, bti,
+                                  trainer.window)
+            return c, (x.sum(), m.sum())
+        return jax.lax.scan(body, 0, (fi, ti, w))
+
+    t_g = timeit(lambda: gather_scan(trainer.dev, fi, ti, w))
+    print(f"gather-only scan: {t_g*1e3:.1f} ms ({t_g/t_full*100:.0f}% of full)")
+
+    # pre-gathered model forward (no gather, no loss): isolates the RNN
+    x, m = jax.jit(gather_windows, static_argnums=4)(
+        trainer.dev["features"], trainer.dev["valid"],
+        jnp.asarray(b.firm_idx[0]), jnp.asarray(b.time_idx[0]), trainer.window)
+
+    @jax.jit
+    def model_only(params, x, m):
+        return trainer._apply(params, x, m)
+
+    t_m = timeit(lambda: model_only(state.params, x, m), reps=10)
+    per_batch_full = t_full / k
+    print(f"model fwd single batch [{x.shape[0]}x{x.shape[1]}]: {t_m*1e3:.2f} ms "
+          f"(full step avg {per_batch_full*1e3:.2f} ms)")
+
+    # batch-size sweep on the raw model forward
+    for mult in (2, 4, 8):
+        xx = jnp.tile(x, (mult, 1, 1, 1)).reshape((-1,) + x.shape[1:])[
+            : x.shape[0] * mult]
+        mm = jnp.tile(m, (mult, 1, 1)).reshape((-1,) + m.shape[1:])[
+            : m.shape[0] * mult]
+        t = timeit(lambda: model_only(state.params, xx, mm), reps=5)
+        print(f"model fwd batch x{mult} [{xx.shape[0]}]: {t*1e3:.2f} ms "
+              f"({t/t_m:.2f}x time for {mult}x work)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Micro-profile of gather_windows sub-parts on the real chip."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lfm_quant_tpu.config import get_preset
+from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+from lfm_quant_tpu.data.windows import DateBatchSampler, device_panel
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    _ = np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _ = np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    cfg = get_preset("c2")
+    d = cfg.data
+    panel = synthetic_panel(n_firms=d.n_firms, n_months=240,
+                            n_features=d.n_features, horizon=d.horizon, seed=0)
+    splits = PanelSplits.by_date(panel, 198601, 198801)
+    sampler = DateBatchSampler(splits.panel, d.window, d.dates_per_batch,
+                               d.firms_per_date, seed=0,
+                               date_range=splits.train_range)
+    dev = device_panel(splits.panel)
+    W = d.window
+    b = sampler.stacked_epoch(0)
+    k = min(18, b.firm_idx.shape[0])
+    fi = jnp.asarray(b.firm_idx[:k])  # [K, D, Bf]
+    ti = jnp.asarray(b.time_idx[:k])  # [K, D]
+
+    T = dev["features"].shape[1]
+
+    def scan(body):
+        @jax.jit
+        def run(dev, fi, ti):
+            def step(c, batch):
+                return c, body(dev, *batch)
+            return jax.lax.scan(step, 0, (fi, ti))
+        return run
+
+    frow = scan(lambda dev, f, t: dev["features"][f].sum())
+    print(f"feature row gather: {timeit(frow, dev, fi, ti)*1e3:.1f} ms")
+
+    vrow = scan(lambda dev, f, t: dev["valid"][f].sum())
+    print(f"valid row gather:   {timeit(vrow, dev, fi, ti)*1e3:.1f} ms")
+
+    def slc(dev, f, t):
+        rows = dev["features"][f]
+        start = jnp.clip(t - (W - 1), 0, T - W)
+        out = jax.vmap(
+            lambda r, s: jax.lax.dynamic_slice_in_dim(r, s, W, axis=1)
+        )(rows, start)
+        return out.sum()
+    print(f"row gather + slice: {timeit(scan(slc), dev, fi, ti)*1e3:.1f} ms")
+
+    # variant: valid as int8 gathered together with features? pack valid as
+    # an extra feature column instead of a separate bool gather
+    feats_aug = jnp.concatenate(
+        [dev["features"], dev["valid"][..., None].astype(jnp.float32)], axis=-1)
+
+    def aug(dev_aug, f, t):
+        rows = dev_aug[f]
+        start = jnp.clip(t - (W - 1), 0, T - W)
+        out = jax.vmap(
+            lambda r, s: jax.lax.dynamic_slice_in_dim(r, s, W, axis=1)
+        )(rows, start)
+        return out.sum()
+    r = scan(lambda dv, f, t: aug(dv["aug"], f, t))
+    print(f"augmented (valid-as-col) gather+slice: "
+          f"{timeit(r, {'aug': feats_aug}, fi, ti)*1e3:.1f} ms")
+
+    # bf16 variant
+    dev_bf = {"aug": feats_aug.astype(jnp.bfloat16)}
+    print(f"bf16 augmented gather+slice: "
+          f"{timeit(r, dev_bf, fi, ti)*1e3:.1f} ms")
+
+    # date-first: slice panel on T per date, then gather firms
+    def datefirst(dev, f, t):
+        start = jnp.clip(t - (W - 1), 0, T - W)
+        def per_date(fd, s):
+            win = jax.lax.dynamic_slice_in_dim(dev["features"], s, W, axis=1)
+            return win[fd]
+        out = jax.vmap(per_date)(f, start)
+        return out.sum()
+    print(f"date-first slice+gather: {timeit(scan(datefirst), dev, fi, ti)*1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

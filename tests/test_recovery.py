@@ -162,3 +162,29 @@ def test_step_timer_accounting():
     t.stop(x, firm_months=100.0)
     assert t.steps == 1 and t.firm_months == 100.0
     assert t.throughput() > 0
+
+
+def test_restore_under_dp_mesh(panel, splits, tmp_path):
+    """Orbax-restored states arrive committed to one device; predict and
+    resume must re-place them on the data-parallel mesh (regression: a
+    restored trainer with n_data_shards>1 crashed with 'incompatible
+    devices' inside jit)."""
+    import dataclasses
+    from lfm_quant_tpu.train.loop import load_trainer
+
+    cfg = dataclasses.replace(cfg_for(tmp_path, epochs=2), n_data_shards=4)
+    run_dir = str(tmp_path / "dp" / cfg.name / "seed0")
+    t1 = Trainer(cfg, splits, run_dir=run_dir)
+    t1.fit()
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "config.json"), "w") as fh:
+        fh.write(cfg.to_json())
+    t2, sp2 = load_trainer(run_dir, panel=panel)
+    assert t2.mesh is not None
+    fc, fv = t2.predict("test")
+    assert fv.any()
+    # resume path under the mesh must also re-place the restored state
+    cfg3 = dataclasses.replace(cfg, optim=dataclasses.replace(cfg.optim, epochs=3))
+    t3 = Trainer(cfg3, splits, run_dir=run_dir)
+    s = t3.fit(resume=True)
+    assert s["history"][-1]["epoch"] == 2

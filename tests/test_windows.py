@@ -151,3 +151,58 @@ def test_short_history_padding_masked(panel):
     m = np.asarray(m)[0, 0]
     assert m.sum() < WINDOW
     assert np.all(np.asarray(x)[0, 0][~m] == 0.0)
+
+
+def test_gather_young_anchor_aligns_to_last_position(panel):
+    """Anchors younger than the window (t < W-1) must still place the
+    anchor month at the LAST window position, with leading padding masked
+    (the fast path clamps its slice start and rolls)."""
+    dev = device_panel(panel)
+    t = WINDOW // 2  # young anchor
+    firms = np.nonzero(panel.valid[:, t])[0][:4].astype(np.int32)
+    x, m = jax.jit(gather_windows, static_argnames="window")(
+        dev["features"], dev["valid"], jnp.asarray(firms[None, :]),
+        jnp.asarray([t], np.int32), window=WINDOW,
+    )
+    x, m = np.asarray(x)[0], np.asarray(m)[0]
+    pad = WINDOW - 1 - t
+    assert not m[:, :pad].any(), "pre-history positions must be masked"
+    assert np.all(x[:, :pad] == 0)
+    for k, f in enumerate(firms):
+        for w in range(pad, WINDOW):
+            tt = t - (WINDOW - 1) + w
+            assert m[k, w] == panel.valid[f, tt]
+            if m[k, w]:
+                np.testing.assert_allclose(x[k, w], panel.features[f, tt])
+
+
+def test_gather_windows_packed_matches_general(panel):
+    from lfm_quant_tpu.data import gather_windows_packed
+
+    dev = device_panel(panel)
+    s = DateBatchSampler(panel, WINDOW, dates_per_batch=3, firms_per_date=8,
+                         seed=2)
+    b = next(iter(s.epoch(0)))
+    # include a young anchor row to exercise the clamp+roll path
+    fi = np.concatenate([b.firm_idx,
+                         b.firm_idx[:1]], axis=0)
+    young = WINDOW // 3
+    pool = np.nonzero(panel.valid[:, young])[0]
+    fi[-1] = pool[np.arange(8) % pool.size]
+    ti = np.concatenate([b.time_idx, [young]]).astype(np.int32)
+
+    xg, mg = jax.jit(gather_windows, static_argnames="window")(
+        dev["features"], dev["valid"], jnp.asarray(fi), jnp.asarray(ti),
+        window=WINDOW)
+    xp, mp = jax.jit(gather_windows_packed, static_argnames="window")(
+        dev["xm"], jnp.asarray(fi), jnp.asarray(ti), window=WINDOW)
+    np.testing.assert_array_equal(np.asarray(mg), np.asarray(mp))
+    np.testing.assert_allclose(np.asarray(xg), np.asarray(xp), rtol=0, atol=0)
+
+    # bf16 packed panel: same mask, features quantized to bf16
+    dev_bf = device_panel(panel, compute_dtype=jnp.bfloat16)
+    xb, mb = jax.jit(gather_windows_packed, static_argnames="window")(
+        dev_bf["xm"], jnp.asarray(fi), jnp.asarray(ti), window=WINDOW)
+    np.testing.assert_array_equal(np.asarray(mg), np.asarray(mb))
+    np.testing.assert_allclose(np.asarray(xb).astype(np.float32),
+                               np.asarray(xg), rtol=1e-2, atol=1e-2)
