@@ -42,6 +42,10 @@ class ModelConfig:
     kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     bf16: bool = False
     heteroscedastic: bool = False
+    # RNN recurrence implementation: "auto" picks the fused Pallas kernel
+    # (ops/pallas_rnn.py) on TPU when no GSPMD mesh is in play (a
+    # pallas_call is opaque to the partitioner), else the XLA lax.scan.
+    scan_impl: str = "auto"  # auto | xla | pallas
 
 
 @dataclasses.dataclass
@@ -149,8 +153,15 @@ def get_preset(name: str) -> RunConfig:
         ) from None
 
 
-def model_kwargs(cfg: RunConfig) -> Tuple[str, Dict[str, Any]]:
-    """Resolve ModelConfig into build_model(kind, **kwargs) arguments."""
+def model_kwargs(cfg: RunConfig, mesh=None) -> Tuple[str, Dict[str, Any]]:
+    """Resolve ModelConfig into build_model(kind, **kwargs) arguments.
+
+    ``mesh`` is the trainer's GSPMD mesh (or None): "auto" scan_impl picks
+    the fused Pallas recurrence only when the model runs un-partitioned on
+    a real TPU — under a mesh the XLA scan stays, because a pallas_call
+    cannot be split by the partitioner.
+    """
+    import jax
     import jax.numpy as jnp
 
     kw = dict(cfg.model.kwargs)
@@ -158,4 +169,10 @@ def model_kwargs(cfg: RunConfig) -> Tuple[str, Dict[str, Any]]:
         kw["dtype"] = jnp.bfloat16
     if cfg.model.heteroscedastic or cfg.optim.loss == "nll":
         kw["heteroscedastic"] = True
+    if cfg.model.kind in ("lstm", "gru") and "scan_impl" not in kw:
+        impl = cfg.model.scan_impl
+        if impl == "auto":
+            impl = ("pallas" if mesh is None
+                    and jax.default_backend() == "tpu" else "xla")
+        kw["scan_impl"] = impl
     return cfg.model.kind, kw

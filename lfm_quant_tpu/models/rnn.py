@@ -89,6 +89,27 @@ class GRURecurrence(nn.Module):
 _CELLS = {"lstm": (LSTMRecurrence, 4, 2), "gru": (GRURecurrence, 3, 1)}
 
 
+class _GateKernel(nn.Module):
+    """Recurrent gate weights for the Pallas scan, declared at the SAME
+    parameter path as the ``nn.scan`` recurrence (``<cell>_<n>/h_proj/
+    kernel``) so checkpoints are interchangeable between ``scan_impl``
+    values. The identity matmul through the Dense returns the kernel matrix
+    itself (cast to the compute dtype) — [H, H]·[H, G·H] is noise next to
+    the recurrence it feeds.
+    """
+
+    features: int
+    hidden: int
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self):
+        eye = jnp.eye(self.hidden, dtype=self.dtype or jnp.float32)
+        return nn.Dense(
+            self.features, use_bias=False, dtype=self.dtype, name="h_proj"
+        )(eye)
+
+
 class RNNModel(nn.Module):
     """Stacked masked RNN over the lookback window → forecast head.
 
@@ -104,6 +125,11 @@ class RNNModel(nn.Module):
     head_hidden: Sequence[int] = ()
     heteroscedastic: bool = False
     dtype: Optional[jnp.dtype] = None
+    # "xla": nn.scan/lax.scan (default; GSPMD-partitionable). "pallas": the
+    # fused single-kernel recurrence (ops/pallas_rnn.py) — h/c resident in
+    # VMEM across all T steps; opaque to GSPMD, so use it single-device or
+    # inside shard_map.
+    scan_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, m, deterministic: bool = True):
@@ -117,12 +143,30 @@ class RNNModel(nn.Module):
         )
         mexp = m[..., None].astype(compute_dtype)  # [..., W, 1]: scan axis -2
         zeros = jnp.zeros((*batch_shape, self.hidden), compute_dtype)
+        if self.scan_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"scan_impl must be 'xla' or 'pallas', got {self.scan_impl!r}")
         for layer in range(self.layers):
             # Hoisted input projection: all T steps in one GEMM.
             xw = nn.Dense(
                 gate_mult * self.hidden, dtype=self.dtype,
                 name=f"{self.cell}_{layer}_xproj",
             )(h)
+            if self.scan_impl == "pallas":
+                from lfm_quant_tpu.ops.pallas_rnn import rnn_scan
+
+                wh = _GateKernel(
+                    gate_mult * self.hidden, self.hidden, dtype=self.dtype,
+                    name=f"{self.cell}_{layer}",
+                )()
+                W = xw.shape[-2]
+                h = rnn_scan(
+                    self.cell,
+                    xw.reshape((-1, W, xw.shape[-1])),
+                    wh,
+                    m.reshape((-1, W)),
+                ).reshape(xw.shape[:-1] + (self.hidden,))
+                continue
             scan = nn.scan(
                 rec_cls,
                 variable_broadcast="params",

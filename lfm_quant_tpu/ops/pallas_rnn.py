@@ -1,0 +1,457 @@
+"""Pallas TPU kernels for the fused LSTM/GRU recurrence — the hot serial op.
+
+Parity/perf target: the reference's cuDNN-backed RNN execution (SURVEY.md §3
+``rnn_model`` row: "cuDNN RNN kernels via TF [INFERRED]"). The XLA path
+(models/rnn.py) drives the recurrence with ``lax.scan``; these kernels fuse
+the whole scan into ONE Pallas call so that:
+
+* the carried state (h, and c for LSTM) lives in **VMEM scratch** across all
+  T steps — it never round-trips through HBM between steps;
+* the per-step gate inputs ``xw[t]`` (the hoisted input projection computed
+  as one big MXU GEMM outside the kernel) are **streamed time-major** by the
+  Pallas grid pipeline, overlapping the next step's DMA with this step's
+  recurrent matmul;
+* all elementwise gate math fuses with the ``[Bb, H] @ [H, G·H]`` recurrent
+  matmul in a single kernel instead of separate XLA fusions per scan step.
+
+Layout: internally time-major ``[T, B, ·]`` so every grid block has MXU/VPU
+friendly trailing dims ``(Bb, G·H)``; the public wrapper takes/returns the
+batch-major ``[B, T, ·]`` layout the models use.
+
+Training support is a full ``jax.custom_vjp``: the backward kernel walks the
+grid in reverse time order, **recomputes the gates** from the saved per-step
+states (one extra recurrent matmul instead of materializing 4·H activations
+per step), and accumulates ``dW_h`` into a VMEM-resident f32 block that is
+written back once at the end.
+
+Masking semantics match models/rnn.py exactly: an invalid month HOLDS the
+carried state, so left-padded short histories keep the initial zero state
+until the first valid month.
+
+Multi-device caveat: a ``pallas_call`` is opaque to GSPMD — under a
+data-parallel mesh it must sit inside ``shard_map`` (each shard runs its own
+kernel on its local batch). Single-device jit (the bench path and all
+single-chip configs) needs no wrapping. ``RNNModel(scan_impl="pallas")``
+(models/rnn.py) is therefore opt-in; the XLA scan remains the default.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_GATES = {"lstm": 4, "gru": 3}
+
+
+# ---------------------------------------------------------------------------
+# Shared step math (used by forward kernel, backward recompute, and the
+# pure-jnp reference that tests validate against).
+# ---------------------------------------------------------------------------
+
+
+def _lstm_gates(gates: jax.Array, forget_bias: float):
+    """Raw gate pre-activations [.., 4H] → (i, f, g, o) activations."""
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    return (jax.nn.sigmoid(i), jax.nn.sigmoid(f + forget_bias),
+            jnp.tanh(g), jax.nn.sigmoid(o))
+
+
+def _gru_parts(xw: jax.Array, hw: jax.Array):
+    """Split projections and apply the reset-after-projection GRU math.
+
+    Returns (z, r, n, hn) — hn (the raw h-side candidate projection) is
+    needed again by the backward pass.
+    """
+    xz, xr, xn = jnp.split(xw, 3, axis=-1)
+    hz, hr, hn = jnp.split(hw, 3, axis=-1)
+    z = jax.nn.sigmoid(xz + hz)
+    r = jax.nn.sigmoid(xr + hr)
+    n = jnp.tanh(xn + r * hn)
+    return z, r, n, hn
+
+
+def rnn_scan_reference(cell: str, xw: jax.Array, wh: jax.Array, m: jax.Array,
+                       forget_bias: float = 1.0) -> jax.Array:
+    """Pure lax.scan reference of the fused recurrence (f32 carry).
+
+    Args match :func:`rnn_scan`. Used as the ground truth in tests and as
+    the CPU fallback; numerically identical to the Pallas kernels up to
+    matmul accumulation order.
+    """
+    B, T, G = xw.shape
+    H = G // _GATES[cell]
+    h0 = jnp.zeros((B, H), jnp.float32)
+    whf = wh.astype(jnp.float32)
+
+    def step(carry, inp):
+        xw_t, m_t = inp
+        keep = m_t.astype(jnp.float32)[:, None]
+        if cell == "lstm":
+            h, c = carry
+            gates = xw_t.astype(jnp.float32) + h @ whf
+            i, f, g, o = _lstm_gates(gates, forget_bias)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            h = keep * h_new + (1.0 - keep) * h
+            c = keep * c_new + (1.0 - keep) * c
+            return (h, c), h
+        h = carry
+        hw = h @ whf
+        z, r, n, _ = _gru_parts(xw_t.astype(jnp.float32), hw)
+        h_new = (1.0 - z) * n + z * h
+        h = keep * h_new + (1.0 - keep) * h
+        return h, h
+
+    carry0 = (h0, h0) if cell == "lstm" else h0
+    xs = (xw.swapaxes(0, 1), m.swapaxes(0, 1))
+    _, h_all = jax.lax.scan(step, carry0, xs)
+    return h_all.swapaxes(0, 1).astype(xw.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernels. Grid = (B blocks, T); t is the fast axis, so for each
+# batch block the pipeline sweeps t = 0..T-1 while h/c persist in scratch.
+# ---------------------------------------------------------------------------
+
+
+def _lstm_fwd_kernel(xw_ref, wh_ref, m_ref, h_out, c_out, h_s, c_s, *,
+                     forget_bias: float):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[...] = jnp.zeros_like(h_s)
+        c_s[...] = jnp.zeros_like(c_s)
+
+    h, c = h_s[...], c_s[...]
+    gates = xw_ref[0].astype(jnp.float32) + jnp.dot(
+        h.astype(wh_ref.dtype), wh_ref[...], preferred_element_type=jnp.float32)
+    i, f, g, o = _lstm_gates(gates, forget_bias)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    keep = m_ref[0].astype(jnp.float32)
+    h = keep * h_new + (1.0 - keep) * h
+    c = keep * c_new + (1.0 - keep) * c
+    h_s[...], c_s[...] = h, c
+    h_out[0] = h.astype(h_out.dtype)
+    c_out[0] = c.astype(c_out.dtype)
+
+
+def _gru_fwd_kernel(xw_ref, wh_ref, m_ref, h_out, h_s):
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[...] = jnp.zeros_like(h_s)
+
+    h = h_s[...]
+    hw = jnp.dot(h.astype(wh_ref.dtype), wh_ref[...],
+                 preferred_element_type=jnp.float32)
+    z, r, n, _ = _gru_parts(xw_ref[0].astype(jnp.float32), hw)
+    h_new = (1.0 - z) * n + z * h
+    keep = m_ref[0].astype(jnp.float32)
+    h = keep * h_new + (1.0 - keep) * h
+    h_s[...] = h
+    h_out[0] = h.astype(h_out.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels. Grid = (B blocks, T) with time index maps REVERSED
+# (grid step t touches real time tr = T-1-t). Gates are recomputed from the
+# saved previous state; dW_h accumulates into a constant-index-map output
+# block that stays VMEM-resident for the whole kernel.
+# ---------------------------------------------------------------------------
+
+
+def _lstm_bwd_kernel(xw_ref, wh_ref, m_ref, hp_ref, cp_ref, cc_ref, dh_ref,
+                     dxw_ref, dwh_ref, dh_s, dc_s, *, forget_bias: float):
+    t = pl.program_id(1)
+    T = pl.num_programs(1)
+
+    @pl.when(t == 0)
+    def _():
+        dh_s[...] = jnp.zeros_like(dh_s)
+        dc_s[...] = jnp.zeros_like(dc_s)
+
+    @pl.when((pl.program_id(0) == 0) & (t == 0))
+    def _():
+        dwh_ref[...] = jnp.zeros_like(dwh_ref)
+
+    # tr == 0 (grid t == T-1): the previous state is the zero initial state;
+    # the clamped index map re-reads step 0, so override with zeros.
+    first = t == T - 1
+    h_prev = jnp.where(first, 0.0, hp_ref[0].astype(jnp.float32))
+    c_prev = jnp.where(first, 0.0, cp_ref[0].astype(jnp.float32))
+    c_cur = cc_ref[0].astype(jnp.float32)  # masked c_t; safe, see below
+    keep = m_ref[0].astype(jnp.float32)
+
+    gates = xw_ref[0].astype(jnp.float32) + jnp.dot(
+        h_prev.astype(wh_ref.dtype), wh_ref[...],
+        preferred_element_type=jnp.float32)
+    i, f, g, o = _lstm_gates(gates, forget_bias)
+
+    dh_t = dh_ref[0].astype(jnp.float32) + dh_s[...]
+    dc_t = dc_s[...]
+    # Mask blend: h_t = keep·h_new + (1-keep)·h_prev (same for c). Every
+    # gate-path grad below carries a ``keep`` factor, so substituting the
+    # *masked* c_t for c_new is exact — where they differ (keep=0) the
+    # terms using it are zero.
+    dh_new = keep * dh_t
+    dc_new = keep * dc_t
+    tc = jnp.tanh(c_cur)
+    do = dh_new * tc
+    dc_tot = dc_new + dh_new * o * (1.0 - tc * tc)
+    di = dc_tot * g
+    df = dc_tot * c_prev
+    dg = dc_tot * i
+    d_gates = jnp.concatenate([
+        di * i * (1.0 - i),
+        df * f * (1.0 - f),
+        dg * (1.0 - g * g),
+        do * o * (1.0 - o),
+    ], axis=-1)
+    dxw_ref[0] = d_gates.astype(dxw_ref.dtype)
+    # dh_prev: direct (masked-out) path + through the recurrent matmul.
+    dh_s[...] = (1.0 - keep) * dh_t + jax.lax.dot_general(
+        d_gates, wh_ref[...].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc_s[...] = (1.0 - keep) * dc_t + dc_tot * f
+    dwh_ref[...] += jax.lax.dot_general(
+        h_prev, d_gates, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _gru_bwd_kernel(xw_ref, wh_ref, m_ref, hp_ref, dh_ref,
+                    dxw_ref, dwh_ref, dh_s):
+    t = pl.program_id(1)
+    T = pl.num_programs(1)
+
+    @pl.when(t == 0)
+    def _():
+        dh_s[...] = jnp.zeros_like(dh_s)
+
+    @pl.when((pl.program_id(0) == 0) & (t == 0))
+    def _():
+        dwh_ref[...] = jnp.zeros_like(dwh_ref)
+
+    first = t == T - 1
+    h_prev = jnp.where(first, 0.0, hp_ref[0].astype(jnp.float32))
+    keep = m_ref[0].astype(jnp.float32)
+
+    hw = jnp.dot(h_prev.astype(wh_ref.dtype), wh_ref[...],
+                 preferred_element_type=jnp.float32)
+    z, r, n, hn = _gru_parts(xw_ref[0].astype(jnp.float32), hw)
+
+    dh_t = dh_ref[0].astype(jnp.float32) + dh_s[...]
+    dh_new = keep * dh_t
+    dz = dh_new * (h_prev - n)
+    dn_raw = dh_new * (1.0 - z) * (1.0 - n * n)
+    dr = dn_raw * hn
+    d_hz = dz * z * (1.0 - z)
+    d_hr = dr * r * (1.0 - r)
+    d_hn = dn_raw * r
+    d_hw = jnp.concatenate([d_hz, d_hr, d_hn], axis=-1)
+    # x-side pre-activations share the z/r grads; the candidate's x side
+    # skips the reset gate (reset-after-projection variant).
+    dxw_ref[0] = jnp.concatenate(
+        [d_hz, d_hr, dn_raw], axis=-1).astype(dxw_ref.dtype)
+    dh_s[...] = (1.0 - keep) * dh_t + dh_new * z + jax.lax.dot_general(
+        d_hw, wh_ref[...].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dwh_ref[...] += jax.lax.dot_general(
+        h_prev, d_hw, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing + custom VJP.
+# ---------------------------------------------------------------------------
+
+
+def _round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def _blocks(B: int, block_b: Optional[int]) -> Tuple[int, int]:
+    """(padded B, block size): batch padded to a multiple of the block."""
+    bb = block_b or min(512, _round_up(B, 8))
+    return _round_up(B, bb), bb
+
+
+def _fwd_call(cell: str, xw_t, wh, m_t, forget_bias, bb, interpret):
+    """Run the forward kernel on time-major inputs; returns per-step states.
+
+    xw_t: [T, Bp, G·H]; m_t: [T, Bp]; returns h_all [T, Bp, H] (+ c_all for
+    LSTM) in xw's dtype.
+    """
+    T, Bp, G = xw_t.shape
+    H = G // _GATES[cell]
+    grid = (Bp // bb, T)
+    vmem = pltpu.VMEM
+    in_specs = [
+        pl.BlockSpec((1, bb, G), lambda i, t: (t, i, 0), memory_space=vmem),
+        pl.BlockSpec((H, G), lambda i, t: (0, 0), memory_space=vmem),
+        pl.BlockSpec((1, bb, 1), lambda i, t: (t, i, 0),
+                     memory_space=vmem),
+    ]
+    state_spec = pl.BlockSpec((1, bb, H), lambda i, t: (t, i, 0),
+                              memory_space=vmem)
+    state_shape = jax.ShapeDtypeStruct((T, Bp, H), xw_t.dtype)
+    scratch = pltpu.VMEM((bb, H), jnp.float32)
+    if cell == "lstm":
+        return pl.pallas_call(
+            functools.partial(_lstm_fwd_kernel, forget_bias=forget_bias),
+            grid=grid, in_specs=in_specs,
+            out_specs=(state_spec, state_spec),
+            out_shape=(state_shape, state_shape),
+            scratch_shapes=[scratch, scratch],
+            interpret=interpret,
+        )(xw_t, wh, m_t)
+    return pl.pallas_call(
+        _gru_fwd_kernel,
+        grid=grid, in_specs=in_specs,
+        out_specs=state_spec, out_shape=state_shape,
+        scratch_shapes=[scratch],
+        interpret=interpret,
+    )(xw_t, wh, m_t)
+
+
+def _bwd_call(cell: str, xw_t, wh, m_t, saved, dh_t, forget_bias, bb,
+              interpret):
+    """Reverse-time backward kernel → (dxw_t [T,Bp,G], dwh f32 [H,G])."""
+    T, Bp, G = xw_t.shape
+    H = G // _GATES[cell]
+    grid = (Bp // bb, T)
+
+    def rev(i, t):
+        return (T - 1 - t, i, 0)
+
+    def rev_prev(i, t):
+        return (jnp.maximum(T - 2 - t, 0), i, 0)
+
+    vmem = pltpu.VMEM
+    state_spec = pl.BlockSpec((1, bb, H), rev, memory_space=vmem)
+    prev_spec = pl.BlockSpec((1, bb, H), rev_prev, memory_space=vmem)
+    in_specs = [
+        pl.BlockSpec((1, bb, G), rev, memory_space=vmem),
+        pl.BlockSpec((H, G), lambda i, t: (0, 0), memory_space=vmem),
+        pl.BlockSpec((1, bb, 1), lambda i, t: (T - 1 - t, i, 0),
+                     memory_space=vmem),
+    ]
+    if cell == "lstm":
+        h_all, c_all = saved
+        in_specs += [prev_spec, prev_spec, state_spec]
+        inputs = (xw_t, wh, m_t, h_all, c_all, c_all, dh_t)
+        kernel = functools.partial(_lstm_bwd_kernel, forget_bias=forget_bias)
+        n_scratch = 2
+    else:
+        (h_all,) = saved
+        in_specs += [prev_spec]
+        inputs = (xw_t, wh, m_t, h_all, dh_t)
+        kernel = _gru_bwd_kernel
+        n_scratch = 1
+    in_specs.append(state_spec)  # dh upstream
+    dxw_t, dwh = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(pl.BlockSpec((1, bb, G), rev, memory_space=vmem),
+                   pl.BlockSpec((H, G), lambda i, t: (0, 0),
+                                memory_space=vmem)),
+        out_shape=(jax.ShapeDtypeStruct((T, Bp, G), xw_t.dtype),
+                   jax.ShapeDtypeStruct((H, G), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((bb, H), jnp.float32)] * n_scratch,
+        interpret=interpret,
+    )(*inputs)
+    return dxw_t, dwh
+
+
+@functools.lru_cache(maxsize=None)
+def _make_scan(cell: str, forget_bias: float, block_b: Optional[int],
+               interpret: bool):
+    """Build the custom-VJP fused scan for one static configuration."""
+
+    def to_time_major(xw, m, bb_pad):
+        xw_t = jnp.swapaxes(xw, 0, 1)
+        m_t = jnp.swapaxes(m, 0, 1)[..., None]
+        if bb_pad:
+            xw_t = jnp.pad(xw_t, ((0, 0), (0, bb_pad), (0, 0)))
+            m_t = jnp.pad(m_t, ((0, 0), (0, bb_pad), (0, 0)))
+        return xw_t, m_t
+
+    @jax.custom_vjp
+    def scan(xw, wh, m):
+        B = xw.shape[0]
+        Bp, bb = _blocks(B, block_b)
+        xw_t, m_t = to_time_major(xw, m, Bp - B)
+        out = _fwd_call(cell, xw_t, wh, m_t, forget_bias, bb, interpret)
+        h_all = out[0] if cell == "lstm" else out
+        return jnp.swapaxes(h_all, 0, 1)[:B]
+
+    def fwd(xw, wh, m):
+        B = xw.shape[0]
+        Bp, bb = _blocks(B, block_b)
+        xw_t, m_t = to_time_major(xw, m, Bp - B)
+        out = _fwd_call(cell, xw_t, wh, m_t, forget_bias, bb, interpret)
+        saved = out if cell == "lstm" else (out,)
+        h_all = saved[0]
+        return (jnp.swapaxes(h_all, 0, 1)[:B],
+                (xw_t, wh, m_t, saved, B))
+
+    def bwd(res, dh):
+        xw_t, wh, m_t, saved, B = res
+        Bp = xw_t.shape[1]
+        _, bb = _blocks(Bp, block_b)
+        dh_t = jnp.swapaxes(dh, 0, 1)
+        if Bp != B:
+            dh_t = jnp.pad(dh_t, ((0, 0), (0, Bp - B), (0, 0)))
+        dxw_t, dwh = _bwd_call(cell, xw_t, wh, m_t, saved,
+                               dh_t.astype(xw_t.dtype), forget_bias, bb,
+                               interpret)
+        dxw = jnp.swapaxes(dxw_t, 0, 1)[:B]
+        # The mask is data, never a trained quantity — no gradient.
+        dm = jnp.zeros((B, xw_t.shape[0]), wh.dtype)
+        return dxw, dwh.astype(wh.dtype), dm
+
+    scan.defvjp(fwd, bwd)
+    return scan
+
+
+def rnn_scan(cell: str, xw: jax.Array, wh: jax.Array, m: jax.Array, *,
+             forget_bias: float = 1.0, block_b: Optional[int] = None,
+             interpret: Optional[bool] = None) -> jax.Array:
+    """Fused masked RNN recurrence as one Pallas kernel (differentiable).
+
+    Args:
+      cell: "lstm" | "gru".
+      xw: ``[B, T, G·H]`` hoisted input projection (``x @ W_x + b`` for all
+        gates; G = 4 for LSTM ifgo, 3 for GRU zrn), f32 or bf16.
+      wh: ``[H, G·H]`` recurrent gate weights.
+      m: ``[B, T]`` step validity (bool or float); invalid steps hold state.
+      forget_bias: LSTM forget-gate bias (ignored for GRU).
+      block_b: batch block size per grid step (default: min(512, B rounded
+        up to 8)); B is padded to a multiple of it.
+      interpret: force Pallas interpret mode; default auto — True off-TPU so
+        the same code runs in CPU CI (SURVEY.md §5's simulated-mesh testing).
+
+    Returns:
+      ``[B, T, H]`` per-step hidden states in ``xw.dtype``.
+    """
+    if cell not in _GATES:
+        raise ValueError(f"cell must be one of {sorted(_GATES)}")
+    if xw.shape[-1] % _GATES[cell]:
+        raise ValueError(
+            f"xw last dim {xw.shape[-1]} not divisible by {_GATES[cell]}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Cast the mask to the compute dtype BEFORE entering the custom-VJP
+    # function: a bool primal would demand a float0 cotangent from bwd.
+    return _make_scan(cell, float(forget_bias), block_b, bool(interpret))(
+        xw, wh, m.astype(xw.dtype))

@@ -81,6 +81,16 @@ class EnsembleTrainer:
             make_mesh(n_seed_mesh, n_data)
             if n_seed_mesh * n_data > 1 else None
         )
+        # The ensemble's mesh may differ from the inner trainer's (which
+        # was built device-count-blind to the seed axis) — re-resolve the
+        # "auto" scan_impl against OUR mesh and rebuild the shared model.
+        # vmap over the seed axis composes with the Pallas recurrence; a
+        # GSPMD mesh does not.
+        from lfm_quant_tpu.config import model_kwargs
+        from lfm_quant_tpu.models import build_model
+
+        kind, kwargs = model_kwargs(cfg, self.mesh)
+        self.inner.model = build_model(kind, **kwargs)
 
         # ONE HBM-resident panel serves the ensemble and the inner trainer
         # (PanelSplits are anchor ranges over a shared panel, not slices).

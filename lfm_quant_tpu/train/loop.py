@@ -211,8 +211,6 @@ class Trainer:
         self.echo = echo
         d = cfg.data
 
-        kind, kwargs = model_kwargs(cfg)
-        self.model = build_model(kind, **kwargs)
         self.loss_fn = make_loss_fn(cfg.optim.loss)
         self.window = d.window
 
@@ -225,6 +223,11 @@ class Trainer:
                 f"dates_per_batch={d.dates_per_batch} must be divisible by "
                 f"n_data_shards={n_data}")
         self.mesh = make_mesh(1, n_data) if n_data > 1 else None
+
+        # Model AFTER the mesh: "auto" scan_impl depends on it (Pallas
+        # recurrence only when un-partitioned — see config.model_kwargs).
+        kind, kwargs = model_kwargs(cfg, self.mesh)
+        self.model = build_model(kind, **kwargs)
 
         self.train_sampler = DateBatchSampler(
             splits.panel, d.window, d.dates_per_batch, d.firms_per_date,

@@ -9,7 +9,11 @@ Compares wall-clock of variants on the real chip:
 Also sweeps batch geometry to test latency- vs throughput-bound.
 """
 
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +45,13 @@ def main():
     splits = PanelSplits.by_date(panel, 198601, 198801)
     trainer = Trainer(cfg, splits)
     state = trainer.init_state()
+
+    # Trainer builds its panel with raw=False (xm only); the gather
+    # isolation below needs the unpacked features/valid arrays too.
+    from lfm_quant_tpu.data.windows import device_panel
+    trainer.dev = device_panel(
+        splits.panel, None,
+        compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None, raw=True)
 
     b = trainer.train_sampler.stacked_epoch(0)
     k = min(30, b.firm_idx.shape[0])
