@@ -32,6 +32,10 @@ class DataConfig:
     val_end: Optional[int] = None
     panel_path: Optional[str] = None  # load a real panel instead of synthetic
     panel_seed: int = 0
+    # Epoch index sampling: "python" (numpy RNG), "native" (C++ sampler,
+    # lfm_quant_tpu/native/), "auto" (native when built). The two engines
+    # produce different-but-equally-valid deterministic orders.
+    sampler_engine: str = "python"
 
 
 @dataclasses.dataclass

@@ -1,0 +1,98 @@
+"""Native (C++) host-runtime components, bound via ctypes.
+
+The TPU compute path is JAX/XLA/Pallas; this package is the native side of
+the HOST runtime around it (SURVEY.md §3: the reference's own native layer
+is stock TF kernels — our framework instead puts the host-side hot loops
+in C++): fast CSV panel ingest and epoch batch sampling (see
+panel_native.cpp).
+
+Build model: compiled on first use with ``g++ -O3 -march=native -shared``
+into this directory (cached; rebuilt when the source is newer). Every
+consumer must degrade gracefully: :func:`get_lib` returns ``None`` when no
+toolchain is available, and callers fall back to the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "panel_native.cpp")
+_SO = os.path.join(_DIR, "_panel_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    # Per-process temp name: concurrent first-use builds (multi-host launch
+    # on a shared FS, pytest-xdist) must not interleave linker output in one
+    # file; each writes its own and the os.replace rename is atomic.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
+           _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=180)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        print(f"lfm_quant_tpu.native: build skipped ({e})", file=sys.stderr)
+        return False
+    if proc.returncode != 0:
+        print(f"lfm_quant_tpu.native: g++ failed:\n{proc.stderr[:2000]}",
+              file=sys.stderr)
+        return False
+    os.replace(tmp, _SO)
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.csv_count_rows.argtypes = [ctypes.c_char_p]
+    lib.csv_count_rows.restype = ctypes.c_longlong
+    lib.csv_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, i32p, ctypes.c_int, ctypes.c_longlong,
+        i32p, i32p, f32p, f32p,
+    ]
+    lib.csv_parse.restype = ctypes.c_longlong
+    lib.sample_epoch.argtypes = [
+        i32p, ctypes.c_longlong, i32p, i64p, ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.c_int, ctypes.c_int, i32p, i32p, f32p,
+    ]
+    lib.sample_epoch.restype = ctypes.c_longlong
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first call; None when
+    unavailable (no toolchain / build error) — callers must fall back."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        fresh = (os.path.exists(_SO)
+                 and os.path.getmtime(_SO) >= os.path.getmtime(_SRC))
+        if not fresh and not _build():
+            _build_failed = True
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError as e:
+            print(f"lfm_quant_tpu.native: load failed ({e})", file=sys.stderr)
+            _build_failed = True
+            return None
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

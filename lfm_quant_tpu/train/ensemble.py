@@ -105,7 +105,7 @@ class EnsembleTrainer:
             DateBatchSampler(
                 splits.panel, d.window, d.dates_per_batch, d.firms_per_date,
                 seed=cfg.seed + s, min_valid_months=d.min_valid_months,
-                date_range=splits.train_range,
+                date_range=splits.train_range, engine=d.sampler_engine,
             )
             for s in range(self.n_seeds)
         ]

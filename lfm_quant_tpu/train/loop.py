@@ -232,7 +232,7 @@ class Trainer:
         self.train_sampler = DateBatchSampler(
             splits.panel, d.window, d.dates_per_batch, d.firms_per_date,
             seed=cfg.seed, min_valid_months=d.min_valid_months,
-            date_range=splits.train_range,
+            date_range=splits.train_range, engine=d.sampler_engine,
         )
         self.val_sampler = DateBatchSampler(
             splits.panel, d.window, 1, d.firms_per_date,
