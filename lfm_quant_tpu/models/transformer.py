@@ -4,18 +4,61 @@ mixed bf16").
 
 Each month of the lookback window is a token. At W=60 tokens full attention
 is trivially cheap (SURVEY.md §6: no sequence parallelism needed at this
-scale), so the encoder is a standard pre-norm stack; key-padding masking
-handles ragged histories. bf16 compute / fp32 params via ``dtype``.
+scale), so the default encoder is a standard pre-norm stack; key-padding
+masking handles ragged histories. bf16 compute / fp32 params via ``dtype``.
+
+Long-context mode (``seq_axis``): for windows that outgrow one chip (daily
+bars, high-frequency panels), set ``seq_axis="seq"`` and run the model
+inside ``shard_map`` with the WINDOW axis sharded over that mesh axis
+(``parallel/ring.py:sequence_parallel_apply``). Attention becomes ring
+attention (K/V blocks rotating over ICI via ppermute), the position
+embedding is sliced per shard, and pooling psums across shards — the
+parameter tree is IDENTICAL to the plain model, so the same checkpoint
+serves both modes.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from lfm_quant_tpu.models.heads import ForecastHead, masked_mean_pool
+
+
+class RingSelfAttention(nn.Module):
+    """Self-attention over a sequence-sharded token axis (ring K/V).
+
+    Parameter-compatible with ``nn.MultiHeadDotProductAttention`` (same
+    query/key/value/out DenseGeneral tree), so plain and sequence-parallel
+    encoders interchange checkpoints.
+    """
+
+    num_heads: int
+    axis_name: str
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, z, kv_mask):
+        from lfm_quant_tpu.parallel.ring import ring_attention
+
+        dim = z.shape[-1]
+        if dim % self.num_heads:
+            raise ValueError(f"dim {dim} not divisible by {self.num_heads}")
+        head_dim = dim // self.num_heads
+        proj = functools.partial(
+            nn.DenseGeneral, features=(self.num_heads, head_dim),
+            dtype=self.dtype)
+        # [B, Wl, H, Dh] → [B, H, Wl, Dh]
+        q, k, v = (proj(name=n)(z).swapaxes(-3, -2)
+                   for n in ("query", "key", "value"))
+        out = ring_attention(q, k, v, kv_mask, axis_name=self.axis_name)
+        out = out.swapaxes(-3, -2)  # [B, Wl, H, Dh]
+        return nn.DenseGeneral(features=dim, axis=(-2, -1), dtype=self.dtype,
+                               name="out")(out)
 
 
 class EncoderBlock(nn.Module):
@@ -24,17 +67,29 @@ class EncoderBlock(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.0
     dtype: Optional[jnp.dtype] = None
+    seq_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, z, attn_mask, deterministic: bool = True):
+    def __call__(self, z, m, deterministic: bool = True):
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(z)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads,
-            dtype=self.dtype,
-            dropout_rate=self.dropout,
-            deterministic=deterministic,
-            name="attn",
-        )(y, y, mask=attn_mask)
+        if self.seq_axis is not None:
+            y = RingSelfAttention(
+                num_heads=self.heads, axis_name=self.seq_axis,
+                dtype=self.dtype, name="attn",
+            )(y, m)
+        else:
+            w = z.shape[-2]
+            # Key-padding mask: queries may be anything (pooling ignores
+            # invalid outputs); keys must be valid months.
+            attn_mask = jnp.broadcast_to(
+                m[..., None, None, :], (*m.shape[:-1], 1, w, w))
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.heads,
+                dtype=self.dtype,
+                dropout_rate=self.dropout,
+                deterministic=deterministic,
+                name="attn",
+            )(y, y, mask=attn_mask)
         z = z + y
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(z)
         y = nn.Dense(self.dim * self.mlp_ratio, dtype=self.dtype, name="mlp_in")(y)
@@ -44,7 +99,13 @@ class EncoderBlock(nn.Module):
 
 
 class TransformerModel(nn.Module):
-    """Pre-norm encoder over month-tokens with masked mean pooling."""
+    """Pre-norm encoder over month-tokens with masked mean pooling.
+
+    ``seq_axis=None``: plain single-device attention over the full window.
+    ``seq_axis="seq"``: sequence-parallel — MUST run inside shard_map with
+    the window axis of (x, m) sharded over that mesh axis; the position
+    table stays global-length (identical params) and is sliced per shard.
+    """
 
     dim: int = 64
     depth: int = 2
@@ -54,23 +115,33 @@ class TransformerModel(nn.Module):
     heteroscedastic: bool = False
     dropout: float = 0.0
     dtype: Optional[jnp.dtype] = None
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, m, deterministic: bool = True):
-        w = x.shape[-2]
+        if self.seq_axis is not None and self.dropout > 0.0:
+            raise ValueError(
+                "dropout is not implemented for the sequence-parallel "
+                "encoder (RingSelfAttention) — it would silently train "
+                "differently from the plain mode; set dropout=0.0 with "
+                "seq_axis")
+        w = x.shape[-2]  # LOCAL window length under seq sharding
         compute_dtype = self.dtype or jnp.float32
         z = nn.Dense(self.dim, dtype=self.dtype, name="embed")(
             x.astype(compute_dtype)
         )
-        pos = self.param(
-            "pos_emb", nn.initializers.normal(0.02), (w, self.dim), jnp.float32
-        )
+        if self.seq_axis is not None:
+            n_shard = jax.lax.psum(1, self.seq_axis)  # static
+            pos = self.param(
+                "pos_emb", nn.initializers.normal(0.02),
+                (w * n_shard, self.dim), jnp.float32)
+            shard = jax.lax.axis_index(self.seq_axis)
+            pos = jax.lax.dynamic_slice_in_dim(pos, shard * w, w, axis=0)
+        else:
+            pos = self.param(
+                "pos_emb", nn.initializers.normal(0.02), (w, self.dim),
+                jnp.float32)
         z = z + pos.astype(z.dtype)
-        # Key-padding mask: queries may be anything (pooling ignores invalid
-        # outputs); keys must be valid months. [..., 1(heads), W(q), W(kv)]
-        attn_mask = jnp.broadcast_to(
-            m[..., None, None, :], (*m.shape[:-1], 1, w, w)
-        )
         for i in range(self.depth):
             z = EncoderBlock(
                 dim=self.dim,
@@ -78,10 +149,17 @@ class TransformerModel(nn.Module):
                 mlp_ratio=self.mlp_ratio,
                 dropout=self.dropout,
                 dtype=self.dtype,
+                seq_axis=self.seq_axis,
                 name=f"block_{i}",
-            )(z, attn_mask, deterministic=deterministic)
+            )(z, m, deterministic=deterministic)
         z = nn.LayerNorm(dtype=self.dtype, name="ln_f")(z)
-        pooled = masked_mean_pool(z, m)
+        if self.seq_axis is not None:
+            mf = m.astype(z.dtype)[..., None]
+            num = jax.lax.psum((z * mf).sum(axis=-2), self.seq_axis)
+            den = jax.lax.psum(mf.sum(axis=-2), self.seq_axis)
+            pooled = num / jnp.maximum(den, 1.0)
+        else:
+            pooled = masked_mean_pool(z, m)
         return ForecastHead(
             hidden=self.head_hidden,
             heteroscedastic=self.heteroscedastic,

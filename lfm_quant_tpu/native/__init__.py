@@ -35,8 +35,9 @@ def _build() -> bool:
     # on a shared FS, pytest-xdist) must not interleave linker output in one
     # file; each writes its own and the os.replace rename is atomic.
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
-           _SRC, "-o", tmp]
+    # No -march=native: the .so may be cached on a shared filesystem and
+    # loaded by hosts with older CPUs (SIGILL is not a graceful fallback).
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", _SRC, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=180)
@@ -55,14 +56,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_int64)
     f32p = ctypes.POINTER(ctypes.c_float)
-    lib.csv_count_rows.argtypes = [ctypes.c_char_p]
-    lib.csv_count_rows.restype = ctypes.c_longlong
-    lib.csv_parse.argtypes = [
-        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int, i32p, ctypes.c_int, ctypes.c_longlong,
+    lib.csv_parse_buf.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, i32p, ctypes.c_int, ctypes.c_longlong,
         i32p, i32p, f32p, f32p,
     ]
-    lib.csv_parse.restype = ctypes.c_longlong
+    lib.csv_parse_buf.restype = ctypes.c_longlong
     lib.sample_epoch.argtypes = [
         i32p, ctypes.c_longlong, i32p, i64p, ctypes.c_longlong,
         ctypes.c_longlong, ctypes.c_int, ctypes.c_int, i32p, i32p, f32p,

@@ -1,4 +1,5 @@
-"""Parallelism layer: device mesh + shardings (seed × data axes)."""
+"""Parallelism layer: device mesh + shardings (seed × data axes) and
+sequence/context parallelism (ring attention over a 'seq' axis)."""
 
 from lfm_quant_tpu.parallel.mesh import (
     batch_sharding,
@@ -8,6 +9,12 @@ from lfm_quant_tpu.parallel.mesh import (
     shard_batch,
     state_sharding,
 )
+from lfm_quant_tpu.parallel.ring import (
+    ring_attention,
+    seq_mesh,
+    sequence_parallel_apply,
+    window_sharding,
+)
 
 __all__ = [
     "make_mesh",
@@ -16,4 +23,8 @@ __all__ = [
     "seed_sharding",
     "state_sharding",
     "shard_batch",
+    "ring_attention",
+    "seq_mesh",
+    "sequence_parallel_apply",
+    "window_sharding",
 ]

@@ -1,0 +1,159 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Scope note (honest parity accounting): the reference has NO sequence
+parallelism and needs none — its lookback is 60 months and it scales over
+firms and seeds (SURVEY.md §3 parallelism table, §6 "Long-context" row).
+This module is the framework's long-context capability beyond the
+reference: when a panel is sampled at higher frequency (daily bars,
+tick-aggregated fundamentals+price windows of thousands of steps), full
+attention's O(W²) memory stops fitting one chip, and the window axis
+itself must shard.
+
+Design (the standard TPU recipe — blockwise/ring attention over ICI):
+
+* The window (token) axis is sharded over a mesh axis (``seq``); each
+  device holds local Q/K/V blocks ``[B, H, W_local, Dh]``.
+* K/V blocks (with their key-validity mask) rotate around the ring via
+  ``jax.lax.ppermute`` — after P-1 hops every query block has attended to
+  every key block. ICI neighbours only; no all-gather materializes the
+  full sequence anywhere.
+* Numerical form is the flash-attention online softmax: running max,
+  running denominator, running numerator, rescaled per hop — bitwise
+  stable regardless of hop order, so results match full attention to
+  float tolerance.
+* Everything is differentiable JAX (ppermute has a transpose rule); the
+  backward pass rides the same ring reversed, courtesy of AD — no custom
+  VJP needed at these sizes. A Pallas RDMA double-buffered ring (guide
+  §Ring Collectives) is the next step if hop latency ever dominates.
+
+Usage: inside ``shard_map`` over a mesh with a ``seq`` axis — see
+``sequence_parallel_apply`` for the packaged entry point, and
+``TransformerModel(seq_axis=...)`` (models/transformer.py) for the
+model-side integration.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+_NEG = -1e30  # additive mask for invalid keys (f32-safe, exp() == 0.0)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    kv_mask: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Masked bidirectional attention with K/V ring-rotated over a mesh axis.
+
+    Must run inside ``shard_map``/``pmap`` binding ``axis_name``; the token
+    axis of all inputs is the LOCAL shard.
+
+    Args:
+      q, k, v: ``[B, H, Wl, Dh]`` local blocks.
+      kv_mask: ``[B, Wl]`` bool — key validity of the LOCAL K/V block
+        (padding months are False). Queries need no mask: consumers pool
+        only valid positions.
+      axis_name: mesh axis to rotate around.
+      scale: attention scale (default ``Dh**-0.5``).
+
+    Returns:
+      ``[B, H, Wl, Dh]`` attention output for the local query block, in
+      ``q.dtype``. Queries whose global key set is empty return 0.
+    """
+    n_dev = jax.lax.psum(1, axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32) * scale
+
+    def block(qf, kb, vb, mb):
+        """One (local Q) × (rotated K/V) block: partial softmax stats."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32))
+        s = s + jnp.where(mb, 0.0, _NEG)[:, None, None, :]
+        m = jnp.max(s, axis=-1)                      # [B, H, Wq]
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)                      # [B, H, Wq]
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+        return m, l, o
+
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    m_acc = jnp.full(qf.shape[:-1], _NEG, jnp.float32)
+    l_acc = jnp.zeros(qf.shape[:-1], jnp.float32)
+    o_acc = jnp.zeros(qf.shape, jnp.float32)
+    kb, vb, mb = k, v, kv_mask
+    for hop in range(n_dev):
+        m_b, l_b, o_b = block(qf, kb, vb, mb)
+        m_new = jnp.maximum(m_acc, m_b)
+        c_acc = jnp.exp(m_acc - m_new)
+        c_b = jnp.exp(m_b - m_new)
+        l_acc = l_acc * c_acc + l_b * c_b
+        o_acc = o_acc * c_acc[..., None] + o_b * c_b[..., None]
+        m_acc = m_new
+        if hop + 1 < n_dev:  # last hop: no rotation needed
+            kb, vb, mb = (jax.lax.ppermute(x, axis_name, perm)
+                          for x in (kb, vb, mb))
+    # Queries with zero valid keys anywhere have l == exp(_NEG-_NEG)*Wg;
+    # their m_acc is still _NEG — zero them rather than emit garbage.
+    empty = m_acc <= _NEG * 0.5
+    out = o_acc / jnp.where(empty, 1.0, l_acc)[..., None]
+    out = jnp.where(empty[..., None], 0.0, out)
+    return out.astype(q.dtype)
+
+
+def sequence_parallel_apply(model, params, x, m, mesh: Mesh,
+                            axis_name: str = SEQ_AXIS):
+    """Apply a ``seq_axis``-aware model with the WINDOW axis sharded.
+
+    Wraps ``model.apply`` in ``shard_map`` over ``mesh``: ``x [B, W, F]``
+    and ``m [B, W]`` shard their window axis over ``axis_name``; params
+    replicate; the output (one forecast per window — every shard holds the
+    identical psum-pooled value) replicates. The model must handle its
+    sharded internals itself (ring attention, position-embedding offset,
+    psum pooling) — exactly what ``TransformerModel(seq_axis=...)`` does.
+
+    The window length must divide by the mesh axis size.
+    """
+    shard_map = jax.shard_map
+
+    W = x.shape[-2]
+    n = mesh.shape[axis_name]
+    if W % n:
+        raise ValueError(f"window {W} not divisible by seq axis size {n}")
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(None, axis_name, None), P(None, axis_name)),
+        out_specs=P(),
+    )
+    def fwd(params, x, m):
+        out = model.apply({"params": params}, x, m)
+        if isinstance(out, tuple):
+            return tuple(o for o in out)
+        return out
+
+    return fwd(params, x, m)
+
+
+def seq_mesh(n: Optional[int] = None) -> Mesh:
+    """A 1-axis ('seq',) mesh over n (default: all) devices."""
+    import numpy as np
+
+    devices = jax.devices()
+    n = n or len(devices)
+    return Mesh(np.asarray(devices[:n]), (SEQ_AXIS,))
+
+
+def window_sharding(mesh: Mesh, axis_name: str = SEQ_AXIS) -> NamedSharding:
+    """NamedSharding for [B, W, F] windows with W over the seq axis."""
+    return NamedSharding(mesh, P(None, axis_name, None))

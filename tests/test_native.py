@@ -79,6 +79,19 @@ def test_csv_engines_handle_quoted_fields(tmp_path):
     np.testing.assert_allclose(a.returns, b.returns, atol=1e-6)
 
 
+def test_csv_engines_handle_quoted_header(tmp_path):
+    p = tmp_path / "qhead.csv"
+    p.write_text('"gvkey","yyyymm","f0","f1"\n'
+                 '1,200001,1.0,2.0\n1,200002,1.1,2.1\n'
+                 '2,200001,3.0,4.0\n2,200002,3.1,4.1\n')
+    a = load_compustat_csv(str(p), engine="pandas", min_cross_section=1,
+                           horizon=1)
+    b = load_compustat_csv(str(p), engine="native", min_cross_section=1,
+                           horizon=1)
+    assert a.feature_names == b.feature_names == ["f0", "f1"]
+    np.testing.assert_allclose(a.features, b.features, atol=1e-6)
+
+
 def test_csv_rejects_off_grid_month(tmp_path):
     # 199913 is inside the [min, max] yyyymm range but not a real month —
     # searchsorted must not silently bucket it into 200001.

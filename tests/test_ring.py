@@ -1,0 +1,141 @@
+"""Sequence parallelism (parallel/ring.py): ring attention vs full
+attention, and the sequence-sharded transformer vs the plain one, on the
+8-device virtual CPU mesh (conftest).
+
+The reference has no sequence parallelism (SURVEY.md §3) — this is the
+framework's long-context capability; correctness is defined against the
+un-sharded computation.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from lfm_quant_tpu.models import build_model
+from lfm_quant_tpu.parallel import (
+    ring_attention,
+    seq_mesh,
+    sequence_parallel_apply,
+)
+
+B, H, W, DH = 3, 2, 32, 8
+
+
+def _qkvm(seed=0, all_invalid_row=False):
+    rng = np.random.default_rng(seed)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, W, DH)), jnp.float32)
+               for _ in range(3))
+    m = jnp.asarray(rng.random((B, W)) < 0.7)
+    if all_invalid_row:
+        m = m.at[0].set(False)
+    return q, k, v, m
+
+
+def full_attention(q, k, v, m):
+    """Dense masked reference."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (DH ** -0.5)
+    s = jnp.where(m[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows with no valid keys: softmax of all -1e30 is uniform garbage —
+    # zero them, matching ring_attention's contract
+    any_valid = m.any(axis=-1)[:, None, None, None]
+    return jnp.where(any_valid, jnp.einsum("bhqk,bhkd->bhqd", p, v), 0.0)
+
+
+def _ring(q, k, v, m, mesh):
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name="seq"),
+        mesh=mesh,
+        in_specs=(P(None, None, "seq", None),) * 3 + (P(None, "seq"),),
+        out_specs=P(None, None, "seq", None),
+    )
+    return fn(q, k, v, m)
+
+
+@pytest.mark.parametrize("n_dev", [2, 8])
+def test_ring_matches_full_attention(n_dev):
+    mesh = seq_mesh(n_dev)
+    q, k, v, m = _qkvm()
+    out = _ring(q, k, v, m, mesh)
+    ref = full_attention(q, k, v, m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_grads_match_full_attention():
+    mesh = seq_mesh(8)
+    q, k, v, m = _qkvm(seed=1)
+
+    def loss(fn, q, k, v):
+        return (fn(q, k, v) ** 2).sum()
+
+    g_ring = jax.grad(
+        lambda *a: loss(lambda q, k, v: _ring(q, k, v, m, mesh), *a),
+        argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(
+        lambda *a: loss(lambda q, k, v: full_attention(q, k, v, m), *a),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ring, g_full, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_ring_empty_key_rows_zero():
+    mesh = seq_mesh(8)
+    q, k, v, m = _qkvm(all_invalid_row=True)
+    out = _ring(q, k, v, m, mesh)
+    assert float(jnp.abs(out[0]).max()) == 0.0
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_sequence_parallel_transformer_matches_plain():
+    """Same params, window sharded 8 ways: identical forecasts."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((16, W, 5)), jnp.float32)
+    m = jnp.asarray(rng.random((16, W)) < 0.8)
+    m = m.at[3].set(False)  # an entirely-invalid history
+    mk = dict(dim=16, depth=2, heads=2)
+    plain = build_model("transformer", **mk)
+    seq = build_model("transformer", seq_axis="seq", **mk)
+    params = plain.init(jax.random.key(0), x, m)["params"]
+
+    out_plain = plain.apply({"params": params}, x, m)
+    mesh = seq_mesh(8)
+    out_seq = sequence_parallel_apply(seq, params, x, m, mesh)
+    np.testing.assert_allclose(np.asarray(out_seq), np.asarray(out_plain),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_sequence_parallel_transformer_grads():
+    """Parameter gradients agree between sharded and plain encoders —
+    the training-path guarantee for long-context mode."""
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((8, W, 5)), jnp.float32)
+    m = jnp.asarray(rng.random((8, W)) < 0.8)
+    y = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+    mk = dict(dim=16, depth=1, heads=2)
+    plain = build_model("transformer", **mk)
+    seq = build_model("transformer", seq_axis="seq", **mk)
+    params = plain.init(jax.random.key(1), x, m)["params"]
+    mesh = seq_mesh(8)
+
+    def loss_plain(p):
+        return ((plain.apply({"params": p}, x, m) - y) ** 2).mean()
+
+    def loss_seq(p):
+        return ((sequence_parallel_apply(seq, p, x, m, mesh) - y) ** 2).mean()
+
+    g_p = jax.grad(loss_plain)(params)
+    g_s = jax.grad(loss_seq)(params)
+    flat_p = jax.tree.leaves_with_path(g_p)
+    flat_s = dict(jax.tree.leaves_with_path(g_s))
+    assert len(flat_p) == len(flat_s)
+    for path, leaf in flat_p:
+        np.testing.assert_allclose(
+            np.asarray(flat_s[path]), np.asarray(leaf), atol=1e-4,
+            rtol=1e-3, err_msg=jax.tree_util.keystr(path))
