@@ -36,6 +36,10 @@ class DataConfig:
     # lfm_quant_tpu/native/), "auto" (native when built). The two engines
     # produce different-but-equally-valid deterministic orders.
     sampler_engine: str = "python"
+    # Window gather: "auto" picks the Pallas DMA gather
+    # (ops/pallas_gather.py) on TPU when the step is un-partitioned, else
+    # the XLA row gather (data/windows.py).
+    gather_impl: str = "auto"  # auto | xla | pallas
 
 
 @dataclasses.dataclass

@@ -288,8 +288,27 @@ class DateBatchSampler:
             )
 
 
+def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int) -> str:
+    """Resolve a gather_impl config ("auto"|"xla"|"pallas") against the
+    execution context: the Pallas DMA gather (ops/pallas_gather.py) needs
+    a real TPU, an un-partitioned step (pallas is opaque to GSPMD), and a
+    panel long enough for an aligned DMA span."""
+    import jax
+
+    from lfm_quant_tpu.ops.pallas_gather import _aligned_span
+
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"gather_impl must be auto|xla|pallas, got {impl!r}")
+    if impl != "auto":
+        return impl
+    ok = (jax.default_backend() == "tpu" and mesh is None
+          and panel.n_months >= window
+          and _aligned_span(window, panel.n_months) is not None)
+    return "pallas" if ok else "xla"
+
+
 def device_panel(panel: Panel, sharding=None, compute_dtype=None,
-                 raw: bool = True) -> dict:
+                 raw: bool = True, lane_pad: bool = False) -> dict:
     """Pin the panel's jit-visible arrays in device memory (HBM).
 
     Returns a dict pytree {features, valid, targets, target_valid, xm} of
@@ -308,12 +327,20 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
     ``raw=False`` drops the separate ``features``/``valid`` arrays (the
     trainers only read ``xm`` and ``targets`` — keeping both would double
     the panel's HBM footprint).
+
+    ``lane_pad=True`` zero-pads ``xm``'s packed width to a 128 multiple —
+    required by the Pallas DMA gather (ops/pallas_gather.py); the logical
+    width stays ``panel.n_features + 1`` (callers pass it as ``fp``).
     """
     put = (lambda x: jax.device_put(x, sharding)) if sharding is not None else jnp.asarray
     xm = np.concatenate(
         [panel.features, panel.valid[..., None].astype(panel.features.dtype)],
         axis=-1,
     )
+    if lane_pad:
+        pad = (-xm.shape[-1]) % 128
+        if pad:
+            xm = np.pad(xm, ((0, 0), (0, 0), (0, pad)))
     if compute_dtype is not None:
         xm = jnp.asarray(xm).astype(compute_dtype)
     dev = {

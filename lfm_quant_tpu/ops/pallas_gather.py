@@ -1,0 +1,208 @@
+"""Pallas TPU kernel for the window gather — exact-window DMAs from HBM.
+
+The hot input path (data/windows.py): every train/eval step turns an int32
+index batch into ``[D, Bf, W, F]`` windows from the HBM-resident packed
+panel ``xm [N, T, F+1]``. The XLA fast path does a contiguous *firm-row*
+gather (``xm[firm_idx]`` → ``[D, Bf, T, F+1]``) then slices the window.
+Profiling (scripts/profile_bench.py) shows that gather at ~56% of the
+whole train step once the RNN runs as a fused Pallas kernel — and it is
+NOT bandwidth-bound: the gathered bytes would take ~30× less time at HBM
+speed; the cost is the scalar-indexed gather op plus the materialized
+``[D, Bf, T, F+1]`` intermediate.
+
+This kernel instead issues one async DMA per firm for EXACTLY the window
+bytes — ``xm[f, start:start+W, :]`` — straight from the panel left in HBM
+into the output's VMEM block, ``block_f`` copies in flight per grid step.
+Indices arrive via ``PrefetchScalarGridSpec`` so source addresses are
+known before the body runs.
+
+Lane padding: Mosaic requires DMA-sliced arrays to have 128-aligned lane
+(last) dims, so the panel is stored feature-padded to 128
+(``pad_lanes`` / ``device_panel(..., lane_pad=True)``). That makes the
+DMA read ``W·128`` instead of ``W·F`` elements per window — still ~4×
+fewer bytes than the XLA path's full rows at the ladder geometry, and the
+op-overhead win dominates regardless.
+
+Young anchors (t < W-1): the slice start clamps to 0 and the wrapper
+rolls the window in XLA afterwards (``jnp.roll`` handles traced shifts;
+the rolled-in future months are masked False and zero-filled). All firms
+of a date share the anchor, so the roll is per-date uniform.
+
+No VJP: the panel is data, not parameters — gradients never flow through
+the gather (the trainers differentiate w.r.t. params only).
+
+GSPMD caveat (same as ops/pallas_rnn.py): a pallas_call is opaque to the
+partitioner — auto-selected only when the step runs un-partitioned; the
+XLA gather remains the default under a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128  # TPU lane width: DMA-sliced arrays need lane-dim alignment
+
+
+def _aligned_span(window: int, n_months: int):
+    """Static 8-aligned DMA extent covering any window placement.
+
+    bf16 HBM memrefs are sublane-tiled (8, 128)(2, 1): DMA slice starts and
+    extents on the month dim must be 8-aligned. The kernel therefore
+    fetches a SUPERWINDOW of static width ``w_pad`` starting at the
+    aligned-down true start; the wrapper slices the real window out per
+    date. Returns (w_pad, max_start8); None when the panel is too short
+    for an aligned span (callers fall back to the XLA path).
+    """
+    w_pad = min(-(-window // 8) * 8 + 8, (n_months // 8) * 8)
+    if w_pad < window:
+        return None
+    return w_pad, ((n_months - w_pad) // 8) * 8
+
+
+def _gather_kernel(fi_ref, ti_ref, xm_hbm, out_ref, sems, *, window: int,
+                   n_months: int, w_pad: int, max_start8: int, bf: int,
+                   bb: int):
+    """Grid (D, Bf//bb): DMA bb aligned superwindows for one date.
+
+    fi_ref:  [D*Bf] int32 scalar-prefetch (flattened firm indices).
+    ti_ref:  [D] int32 scalar-prefetch (anchor month per date).
+    xm_hbm:  [N, T, 128k] lane-padded packed panel, left in HBM.
+    out_ref: [1, bb, w_pad, 128k] VMEM block of the output.
+    sems:    DMA semaphore array, one per in-flight firm copy.
+    """
+    d = pl.program_id(0)
+    j = pl.program_id(1)
+    t = ti_ref[d]
+    start = jnp.clip(t - (window - 1), 0, n_months - window)
+    start8 = pl.multiple_of(
+        jnp.minimum((start // 8) * 8, max_start8), 8)
+
+    def issue(i):
+        f = fi_ref[d * bf + j * bb + i]
+        return pltpu.make_async_copy(
+            xm_hbm.at[f, pl.ds(start8, w_pad), :],
+            out_ref.at[0, i],
+            sems.at[i],
+        )
+
+    for i in range(bb):
+        issue(i).start()
+    for i in range(bb):
+        issue(i).wait()
+
+
+@functools.lru_cache(maxsize=None)
+def _make_gather(window: int, n_months: int, bf: int, bb: int,
+                 interpret: bool):
+    w_pad, max_start8 = _aligned_span(window, n_months)
+
+    def call(xm, firm_idx, time_idx):
+        D = firm_idx.shape[0]
+        Fp = xm.shape[-1]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(D, bf // bb),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)],
+            out_specs=pl.BlockSpec(
+                (1, bb, w_pad, Fp), lambda d, j, fi, ti: (d, j, 0, 0),
+                memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((bb,))],
+        )
+        kernel = functools.partial(
+            _gather_kernel, window=window, n_months=n_months, w_pad=w_pad,
+            max_start8=max_start8, bf=bf, bb=bb)
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((D, bf, w_pad, Fp), xm.dtype),
+            interpret=interpret,
+        )(firm_idx.reshape(-1), time_idx, xm)
+
+    return call
+
+
+def pad_lanes(xm: jax.Array) -> jax.Array:
+    """Zero-pad the packed panel's feature dim to a lane multiple.
+
+    Mosaic (this jaxlib) rejects DMA slices of arrays whose last dim is
+    not 128-aligned — even full-extent ones. Production callers store the
+    panel pre-padded (``device_panel(..., lane_pad=True)``); the padding
+    is zeros, so the validity column position (logical Fp-1) is the only
+    bookkeeping.
+    """
+    pad = (-xm.shape[-1]) % _LANE
+    if pad == 0:
+        return xm
+    return jnp.pad(xm, ((0, 0), (0, 0), (0, pad)))
+
+
+def gather_windows_pallas(
+    xm: jax.Array,
+    firm_idx: jax.Array,
+    time_idx: jax.Array,
+    window: int,
+    fp: Optional[int] = None,
+    block_f: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Exact-window gather over the packed panel, as one Pallas kernel.
+
+    Same contract as ``data.windows.gather_windows_packed`` (the [D, Bf]
+    date layout, T >= W): returns ``(x [D, Bf, W, F], m [D, Bf, W])`` with
+    ``x`` in ``xm.dtype``.
+
+    Args:
+      xm: ``[N, T, Fp]`` packed panel — lane-padded (``pad_lanes``) for
+        zero-copy dispatch; un-padded inputs are padded here (a per-call
+        copy: fine for tests, wasteful in a train step).
+      fp: the LOGICAL packed width (features + validity column) before any
+        lane padding; defaults to ``xm.shape[-1]``.
+    """
+    D, bf = firm_idx.shape
+    if time_idx.shape != (D,):
+        raise ValueError(f"expected time_idx [D={D}], got {time_idx.shape}")
+    T = xm.shape[1]
+    if T < window or _aligned_span(window, T) is None:
+        raise ValueError("panel too short for an aligned DMA span; use the "
+                         "XLA path")
+    fp = fp or xm.shape[-1]
+    xm = pad_lanes(xm)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_f is None:
+        # Largest divisor of Bf whose output block stays under ~2.5 MB —
+        # measured sweet spot (128 at the bf16 ladder geometry: 2.6× the
+        # XLA gather; 256 thrashes VMEM double-buffering and loses).
+        w_pad = _aligned_span(window, T)[0]
+        blk_bytes = w_pad * xm.shape[-1] * xm.dtype.itemsize
+        block_f = next(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
+                       if bf % b == 0 and b * blk_bytes <= (5 << 20) // 2)
+    packed = _make_gather(window, T, bf, block_f, bool(interpret))(
+        xm, firm_idx, time_idx)
+
+    # The kernel fetched an 8-aligned superwindow: cut the true window out
+    # (per-date offset), then roll young anchors so the anchor sits at the
+    # LAST position and mask off the rolled-in months. All XLA-side: these
+    # ops run on the small [D, Bf, W, Fp] output, not the panel.
+    w_pad, max_start8 = _aligned_span(window, T)
+    start = jnp.clip(time_idx - (window - 1), 0, T - window)
+    start8 = jnp.minimum((start // 8) * 8, max_start8)
+    off = start - start8  # [D], 0 <= off <= w_pad - window
+    packed = jax.vmap(
+        lambda p, o: jax.lax.dynamic_slice_in_dim(p, o, window, axis=-2)
+    )(packed, off)
+    shift = (window - 1) - (time_idx - start)  # [D]
+    packed = jax.vmap(lambda p, s: jnp.roll(p, s, axis=-2))(packed, shift)
+    pos = jnp.arange(window, dtype=jnp.int32)
+    live = pos[None, :] >= shift[:, None]  # [D, W]
+    m = (packed[..., fp - 1] != 0) & live[:, None, :]
+    # Contract parity with the XLA path: invalid months are zero-filled.
+    x = jnp.where(m[..., None], packed[..., :fp - 1],
+                  jnp.zeros((), packed.dtype))
+    return x, m

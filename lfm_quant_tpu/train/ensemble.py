@@ -83,21 +83,26 @@ class EnsembleTrainer:
         )
         # The ensemble's mesh may differ from the inner trainer's (which
         # was built device-count-blind to the seed axis) — re-resolve the
-        # "auto" scan_impl against OUR mesh and rebuild the shared model.
-        # vmap over the seed axis composes with the Pallas recurrence; a
-        # GSPMD mesh does not.
+        # "auto" scan_impl and gather_impl against OUR mesh and rebuild
+        # the shared model. vmap over the seed axis composes with the
+        # Pallas kernels; a GSPMD mesh does not.
         from lfm_quant_tpu.config import model_kwargs
+        from lfm_quant_tpu.data.windows import resolve_gather_impl
         from lfm_quant_tpu.models import build_model
 
         kind, kwargs = model_kwargs(cfg, self.mesh)
         self.inner.model = build_model(kind, **kwargs)
+        self.inner._gather_impl = resolve_gather_impl(
+            cfg.data.gather_impl, self.mesh, splits.panel, cfg.data.window)
 
         # ONE HBM-resident panel serves the ensemble and the inner trainer
-        # (PanelSplits are anchor ranges over a shared panel, not slices).
+        # (PanelSplits are anchor ranges over a shared panel, not slices);
+        # lane-padded iff the re-resolved gather (below) is the Pallas DMA
+        # kernel.
         self.dev = device_panel(
             splits.panel, replicated(self.mesh) if self.mesh else None,
             compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
-            raw=False)
+            raw=False, lane_pad=self.inner._gather_impl == "pallas")
         self.inner.dev = self.dev
 
         d = cfg.data

@@ -33,6 +33,7 @@ from lfm_quant_tpu.data.windows import (
     device_panel,
     gather_targets,
     gather_windows_packed,
+    resolve_gather_impl,
 )
 from lfm_quant_tpu.models import build_model
 from lfm_quant_tpu.parallel import make_mesh, replicated, shard_batch
@@ -239,6 +240,11 @@ class Trainer:
             seed=cfg.seed, min_valid_months=d.min_valid_months,
             min_cross_section=1, date_range=splits.val_range,
         )
+        # Gather implementation (Pallas DMA gather needs a lane-padded
+        # panel, so it must be resolved before the device transfer).
+        self._gather_impl = resolve_gather_impl(
+            d.gather_impl, self.mesh, splits.panel, d.window)
+        self._fp = splits.panel.n_features + 1  # logical packed width
         if build_data:
             # ONE device-resident copy of the full panel serves training,
             # eval and inference (PanelSplits are anchor ranges, not slices).
@@ -246,7 +252,7 @@ class Trainer:
             self.dev = device_panel(
                 splits.panel, panel_sharding,
                 compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
-                raw=False)
+                raw=False, lane_pad=self._gather_impl == "pallas")
         else:
             self.dev = None
 
@@ -277,12 +283,22 @@ class Trainer:
             return tuple(o.reshape(lead) for o in out)
         return out.reshape(lead)
 
+    def _gather(self, xm, firm_idx, time_idx):
+        """The resolved window gather (ops/pallas_gather.py DMA kernel or
+        the XLA row gather). NOTE: with the Pallas impl the device panel
+        is lane-padded — the XLA path must not read it (its validity
+        column position differs)."""
+        if self._gather_impl == "pallas":
+            from lfm_quant_tpu.ops.pallas_gather import gather_windows_pallas
+
+            return gather_windows_pallas(
+                xm, firm_idx, time_idx, self.window, fp=self._fp)
+        return gather_windows_packed(xm, firm_idx, time_idx, self.window)
+
     def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
                    weight):
         def loss_of(params):
-            x, m = gather_windows_packed(
-                dev["xm"], firm_idx, time_idx, self.window
-            )
+            x, m = self._gather(dev["xm"], firm_idx, time_idx)
             y = gather_targets(dev["targets"], firm_idx, time_idx)
             out = self._apply(params, x, m)
             return self.loss_fn(out, y, weight)
@@ -327,7 +343,7 @@ class Trainer:
 
         def chunk(args):
             fi, ti, w = args
-            x, m = gather_windows_packed(dev["xm"], fi, ti, self.window)
+            x, m = self._gather(dev["xm"], fi, ti)
             y = gather_targets(dev["targets"], fi, ti)
             pred = _point_forecast(self._apply(params, x, m))
             ic = spearman_ic(pred, y, w)
