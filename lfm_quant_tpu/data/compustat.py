@@ -99,29 +99,45 @@ def _parse_native(path, feature_cols):
     # handles RFC-4180 quoting in the header, matching the C field scanner.
     with open(path, "rb") as fh:
         data = fh.read()
-    head = io.StringIO(data[:1 << 20].decode("utf-8", "replace"))
+    head_bytes = data[:1 << 20]
+    if len(data) > len(head_bytes):
+        # The buffer cut the file mid-row: drop the trailing partial line
+        # or the sniff would misread a truncated numeric ('1.25e-') as text.
+        head_bytes = head_bytes[:head_bytes.rfind(b"\n") + 1]
+    head = io.StringIO(head_bytes.decode("utf-8", "replace"))
     reader = _csv.reader(head)
     header = next(reader, [])
-    first_data = next(reader, [])
     cols = {c: i for i, c in enumerate(header)}
     missing = [c for c in ("gvkey", "yyyymm") if c not in cols]
     if missing:
         raise ValueError(f"input file lacks required columns {missing}")
     if feature_cols is None:
-        def numeric(i):
-            if i >= len(first_data):
-                return True
-            v = first_data[i].strip().strip('"')  # parser strips quotes too
-            if not v:
-                return True  # empty: undecidable, let the parser NaN it
-            try:
-                float(v)
-                return True
-            except ValueError:
-                return False
+        # Type-sniff candidate feature columns over MANY rows (the whole
+        # 1 MB head buffer, up to 4096 rows) — a single-row sniff
+        # misclassifies sparse text columns whose first value is blank,
+        # and silently NaNs text in mostly-numeric columns. A column is
+        # numeric iff no scanned non-empty value fails float(); all-empty
+        # columns stay included (pandas parses those as float NaN columns,
+        # so inclusion is the parity behavior).
+        saw_text = [False] * len(header)
+        n_scanned = 0
+        for row in reader:
+            if not row:
+                continue
+            for i in range(min(len(row), len(header))):
+                v = row[i].strip().strip('"')  # parser strips quotes too
+                if not v:
+                    continue
+                try:
+                    float(v)
+                except ValueError:
+                    saw_text[i] = True
+            n_scanned += 1
+            if n_scanned >= 4096:
+                break
 
         feature_cols = [c for c in header
-                        if c not in RESERVED and numeric(cols[c])]
+                        if c not in RESERVED and not saw_text[cols[c]]]
         ignored = [c for c in header
                    if c not in RESERVED and c not in feature_cols]
         if ignored:
@@ -197,10 +213,11 @@ def load_compustat_csv(
         "native", or "pandas". On well-formed numeric files (including
         RFC-4180 quoted fields) the engines produce identical panels; the
         native one (lfm_quant_tpu/native/) parses ~2× faster than the
-        pandas C parser (measured, single core, one disk read). One divergence remains:
-        with ``feature_cols=None`` the native engine type-sniffs from the
-        first data row, pandas from whole columns — pass explicit
-        ``feature_cols`` for files with mixed-type columns.
+        pandas C parser (measured, single core, one disk read). One
+        divergence remains: with ``feature_cols=None`` the native engine
+        type-sniffs from the first ~4096 rows (1 MB), pandas from whole
+        columns — pass explicit ``feature_cols`` for files whose first
+        text value appears later than that.
     """
     if engine not in ("auto", "native", "pandas"):
         raise ValueError(f"engine must be auto|native|pandas, got {engine!r}")

@@ -295,7 +295,7 @@ def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int) -> str:
     panel long enough for an aligned DMA span."""
     import jax
 
-    from lfm_quant_tpu.ops.pallas_gather import _aligned_span
+    from lfm_quant_tpu.ops.pallas_gather import _aligned_span, padded_months
 
     if impl not in ("auto", "xla", "pallas"):
         raise ValueError(f"gather_impl must be auto|xla|pallas, got {impl!r}")
@@ -303,7 +303,7 @@ def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int) -> str:
         return impl
     ok = (jax.default_backend() == "tpu" and mesh is None
           and panel.n_months >= window
-          and _aligned_span(window, panel.n_months) is not None)
+          and _aligned_span(window, padded_months(panel.n_months)) is not None)
     return "pallas" if ok else "xla"
 
 
@@ -328,9 +328,12 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
     trainers only read ``xm`` and ``targets`` — keeping both would double
     the panel's HBM footprint).
 
-    ``lane_pad=True`` zero-pads ``xm``'s packed width to a 128 multiple —
-    required by the Pallas DMA gather (ops/pallas_gather.py); the logical
-    width stays ``panel.n_features + 1`` (callers pass it as ``fp``).
+    ``lane_pad=True`` makes ``xm`` Pallas-DMA-ready: zero-pads the packed
+    width to a 128 multiple AND the month dim to a multiple of 8 (both
+    required by ops/pallas_gather.py — 8-aligned superwindow DMAs cannot
+    reach the tail of an unpadded month axis). The logical width stays
+    ``panel.n_features + 1`` (callers pass it as ``fp``); phantom months
+    carry validity 0.
     """
     put = (lambda x: jax.device_put(x, sharding)) if sharding is not None else jnp.asarray
     xm = np.concatenate(
@@ -338,9 +341,12 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
         axis=-1,
     )
     if lane_pad:
-        pad = (-xm.shape[-1]) % 128
-        if pad:
-            xm = np.pad(xm, ((0, 0), (0, 0), (0, pad)))
+        from lfm_quant_tpu.ops.pallas_gather import padded_lanes, padded_months
+
+        pad_f = padded_lanes(xm.shape[-1]) - xm.shape[-1]
+        pad_t = padded_months(xm.shape[1]) - xm.shape[1]
+        if pad_f or pad_t:
+            xm = np.pad(xm, ((0, 0), (0, pad_t), (0, pad_f)))
     if compute_dtype is not None:
         xm = jnp.asarray(xm).astype(compute_dtype)
     dev = {

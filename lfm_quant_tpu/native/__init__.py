@@ -6,8 +6,10 @@ is stock TF kernels — our framework instead puts the host-side hot loops
 in C++): fast CSV panel ingest and epoch batch sampling (see
 panel_native.cpp).
 
-Build model: compiled on first use with ``g++ -O3 -march=native -shared``
-into this directory (cached; rebuilt when the source is newer). Every
+Build model: compiled on first use with ``g++ -O3 -shared`` (deliberately
+no ``-march=native``: the cached .so may be loaded by other hosts on a
+shared filesystem — see ``_build``) into this directory (cached; rebuilt
+when the source is newer). Every
 consumer must degrade gracefully: :func:`get_lib` returns ``None`` when no
 toolchain is available, and callers fall back to the pure-Python path.
 """

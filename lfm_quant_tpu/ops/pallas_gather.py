@@ -47,6 +47,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _LANE = 128  # TPU lane width: DMA-sliced arrays need lane-dim alignment
+_SUBLANE = 8  # month-dim tiling: DMA slice starts/extents must align to it
+
+
+def padded_months(n_months: int) -> int:
+    """Month count after ``pad_months`` — the single source of truth for
+    the sublane alignment shared with data/windows.py (device_panel,
+    resolve_gather_impl)."""
+    return -(-n_months // _SUBLANE) * _SUBLANE
+
+
+def padded_lanes(width: int) -> int:
+    """Packed width after ``pad_lanes``."""
+    return -(-width // _LANE) * _LANE
 
 
 def _aligned_span(window: int, n_months: int):
@@ -58,11 +71,23 @@ def _aligned_span(window: int, n_months: int):
     aligned-down true start; the wrapper slices the real window out per
     date. Returns (w_pad, max_start8); None when the panel is too short
     for an aligned span (callers fall back to the XLA path).
+
+    ``n_months`` must be a multiple of 8 (``pad_months``): an 8-aligned
+    span of 8-multiple width can only end on an 8-aligned offset, so with
+    T % 8 != 0 the last T % 8 months are unreachable and tail anchors
+    would silently clamp to a window shifted up to 7 months early —
+    exactly the newest data. Month-padding (zeros → validity column 0)
+    removes the case instead of special-casing it.
     """
-    w_pad = min(-(-window // 8) * 8 + 8, (n_months // 8) * 8)
+    if n_months % _SUBLANE:
+        return None  # callers must month-pad the panel first (pad_months)
+    # Clamping to n_months keeps near-window-length panels on the fast
+    # path: with w_pad == n_months, max_start8 == 0 and the offset bound
+    # off <= n_months - window == w_pad - window still holds.
+    w_pad = min(-(-window // _SUBLANE) * _SUBLANE + _SUBLANE, n_months)
     if w_pad < window:
         return None
-    return w_pad, ((n_months - w_pad) // 8) * 8
+    return w_pad, n_months - w_pad
 
 
 def _gather_kernel(fi_ref, ti_ref, xm_hbm, out_ref, sems, *, window: int,
@@ -136,10 +161,28 @@ def pad_lanes(xm: jax.Array) -> jax.Array:
     is zeros, so the validity column position (logical Fp-1) is the only
     bookkeeping.
     """
-    pad = (-xm.shape[-1]) % _LANE
+    pad = padded_lanes(xm.shape[-1]) - xm.shape[-1]
     if pad == 0:
         return xm
     return jnp.pad(xm, ((0, 0), (0, 0), (0, pad)))
+
+
+def pad_months(xm: jax.Array) -> jax.Array:
+    """Zero-pad the packed panel's month dim to a multiple of 8.
+
+    Required by ``_aligned_span``: 8-aligned superwindow DMAs can never
+    reach the last ``T % 8`` months of an unpadded panel (the span end is
+    8-aligned), so tail anchors would fetch windows shifted up to 7 months
+    early. The padding is zeros, so the validity column marks the phantom
+    months invalid; real windows never extend past the last true month
+    (``start <= T_true - W``), only superwindow overfetch touches them.
+    Production callers store the panel pre-padded
+    (``device_panel(..., lane_pad=True)`` pads months AND lanes).
+    """
+    pad = padded_months(xm.shape[1]) - xm.shape[1]
+    if pad == 0:
+        return xm
+    return jnp.pad(xm, ((0, 0), (0, pad), (0, 0)))
 
 
 def gather_windows_pallas(
@@ -158,28 +201,32 @@ def gather_windows_pallas(
     ``x`` in ``xm.dtype``.
 
     Args:
-      xm: ``[N, T, Fp]`` packed panel — lane-padded (``pad_lanes``) for
-        zero-copy dispatch; un-padded inputs are padded here (a per-call
-        copy: fine for tests, wasteful in a train step).
+      xm: ``[N, T, Fp]`` packed panel — lane-padded (``pad_lanes``) and
+        month-padded to a multiple of 8 (``pad_months``) for zero-copy
+        dispatch; un-padded inputs are padded here (a per-call copy: fine
+        for tests, wasteful in a train step).
       fp: the LOGICAL packed width (features + validity column) before any
         lane padding; defaults to ``xm.shape[-1]``.
     """
     D, bf = firm_idx.shape
     if time_idx.shape != (D,):
         raise ValueError(f"expected time_idx [D={D}], got {time_idx.shape}")
+    if xm.shape[1] < window:
+        raise ValueError("panel shorter than the window; use the XLA path")
+    fp = fp or xm.shape[-1]
+    xm = pad_months(pad_lanes(xm))  # no-ops when stored pre-padded
     T = xm.shape[1]
-    if T < window or _aligned_span(window, T) is None:
+    span = _aligned_span(window, T)
+    if span is None:
         raise ValueError("panel too short for an aligned DMA span; use the "
                          "XLA path")
-    fp = fp or xm.shape[-1]
-    xm = pad_lanes(xm)
+    w_pad, max_start8 = span
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_f is None:
         # Largest divisor of Bf whose output block stays under ~2.5 MB —
         # measured sweet spot (128 at the bf16 ladder geometry: 2.6× the
         # XLA gather; 256 thrashes VMEM double-buffering and loses).
-        w_pad = _aligned_span(window, T)[0]
         blk_bytes = w_pad * xm.shape[-1] * xm.dtype.itemsize
         block_f = next(b for b in (128, 64, 32, 16, 8, 4, 2, 1)
                        if bf % b == 0 and b * blk_bytes <= (5 << 20) // 2)
@@ -190,7 +237,6 @@ def gather_windows_pallas(
     # (per-date offset), then roll young anchors so the anchor sits at the
     # LAST position and mask off the rolled-in months. All XLA-side: these
     # ops run on the small [D, Bf, W, Fp] output, not the panel.
-    w_pad, max_start8 = _aligned_span(window, T)
     start = jnp.clip(time_idx - (window - 1), 0, T - window)
     start8 = jnp.minimum((start // 8) * 8, max_start8)
     off = start - start8  # [D], 0 <= off <= w_pad - window

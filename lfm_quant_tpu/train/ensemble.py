@@ -267,11 +267,11 @@ class EnsembleTrainer:
         fi, ti, w = self.inner._batch_args(b)
         pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
         pred = np.asarray(pred)  # [S, M, bf]
-        for j in range(pred.shape[1]):
-            t = int(b.time_idx[j])
-            real = b.weight[j] > 0
-            out[:, b.firm_idx[j][real], t] = pred[:, j, real]
-            out_valid[b.firm_idx[j][real], t] = True
+        real = b.weight > 0  # [M, bf]
+        rows = b.firm_idx[real]
+        cols = np.broadcast_to(b.time_idx[:, None], b.firm_idx.shape)[real]
+        out[:, rows, cols] = pred[:, real]
+        out_valid[rows, cols] = True
         return out, out_valid
 
 

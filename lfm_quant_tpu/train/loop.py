@@ -484,11 +484,11 @@ class Trainer:
         fi, ti, w = self._batch_args(b)
         pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
         pred = np.asarray(pred)  # [M, bf]
-        for j in range(pred.shape[0]):
-            t = int(b.time_idx[j])
-            real = b.weight[j] > 0
-            out[b.firm_idx[j][real], t] = pred[j][real]
-            out_valid[b.firm_idx[j][real], t] = True
+        real = b.weight > 0  # [M, bf]
+        rows = b.firm_idx[real]
+        cols = np.broadcast_to(b.time_idx[:, None], b.firm_idx.shape)[real]
+        out[rows, cols] = pred[real]
+        out_valid[rows, cols] = True
         return out, out_valid
 
 

@@ -49,9 +49,12 @@ def main():
     # Trainer builds its panel with raw=False (xm only); the gather
     # isolation below needs the unpacked features/valid arrays too.
     from lfm_quant_tpu.data.windows import device_panel
+    # lane_pad must match what Trainer.__init__ chose, or a pallas-resolved
+    # gather re-pads the whole panel inside every profiled step.
     trainer.dev = device_panel(
         splits.panel, None,
-        compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None, raw=True)
+        compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None, raw=True,
+        lane_pad=trainer._gather_impl == "pallas")
 
     b = trainer.train_sampler.stacked_epoch(0)
     k = min(30, b.firm_idx.shape[0])

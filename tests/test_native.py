@@ -92,6 +92,48 @@ def test_csv_engines_handle_quoted_header(tmp_path):
     np.testing.assert_allclose(a.features, b.features, atol=1e-6)
 
 
+def test_csv_sniff_excludes_sparse_text_column(tmp_path):
+    """A text column whose FIRST data value is blank must still be
+    excluded from the auto-sniffed feature set (the sniff scans many rows,
+    not just the first — a single-row sniff silently included it as an
+    all-NaN feature and invalidated every row of the panel)."""
+    p = tmp_path / "sparse_text.csv"
+    p.write_text(
+        "gvkey,yyyymm,f0,sector,ret\n"
+        "1,200001,1.0,,0.01\n"        # sector blank on the first row
+        "1,200002,1.1,tech,0.02\n"    # ...but text later
+        "2,200001,3.0,,0.03\n"
+        "2,200002,3.1,energy,0.04\n")
+    a = load_compustat_csv(str(p), engine="pandas", min_cross_section=1,
+                           horizon=1)
+    b = load_compustat_csv(str(p), engine="native", min_cross_section=1,
+                           horizon=1)
+    assert a.feature_names == b.feature_names == ["f0"]
+    np.testing.assert_array_equal(a.valid, b.valid)
+    assert b.valid.all()
+    np.testing.assert_allclose(a.features, b.features, atol=1e-6)
+
+
+def test_csv_sniff_all_empty_column_matches_pandas(tmp_path):
+    """An entirely-empty column parses as numeric NaN in pandas (float
+    dtype → included as a feature); the native sniff must agree, and the
+    resulting all-NaN feature invalidates rows identically."""
+    p = tmp_path / "empty_col.csv"
+    p.write_text(
+        "gvkey,yyyymm,f0,f1,ret\n"
+        "1,200001,1.0,,0.01\n"
+        "1,200002,1.1,,0.02\n"
+        "2,200001,3.0,,0.03\n"
+        "2,200002,3.1,,0.04\n")
+    a = load_compustat_csv(str(p), engine="pandas", min_cross_section=1,
+                           horizon=1)
+    b = load_compustat_csv(str(p), engine="native", min_cross_section=1,
+                           horizon=1)
+    assert a.feature_names == b.feature_names == ["f0", "f1"]
+    np.testing.assert_array_equal(a.valid, b.valid)
+    assert not b.valid.any()  # all-NaN f1 ⇒ no valid cells anywhere
+
+
 def test_csv_rejects_off_grid_month(tmp_path):
     # 199913 is inside the [min, max] yyyymm range but not a real month —
     # searchsorted must not silently bucket it into 200001.

@@ -1,0 +1,146 @@
+"""Pallas DMA window gather (ops/pallas_gather.py) vs the XLA row gather.
+
+Runs in Pallas interpret mode on the CPU test platform (the wrapper
+auto-selects it off-TPU). The critical cases are panels whose month count
+is NOT a multiple of 8: 8-aligned superwindow DMAs cannot reach the tail
+of an unpadded month axis, so without month padding, anchors in the last
+T % 8 months silently fetched windows shifted up to 7 months early —
+look-ahead-shifted data at exactly the newest dates. ``pad_months`` (and
+``device_panel(lane_pad=True)``) removes the case; these tests pin the
+wrapper to exact parity with ``gather_windows_packed`` for every T % 8
+residue and tail/young/mid anchor placement.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.data.panel import Panel
+from lfm_quant_tpu.data.windows import (
+    device_panel,
+    gather_windows_packed,
+    resolve_gather_impl,
+)
+from lfm_quant_tpu.ops.pallas_gather import (
+    _aligned_span,
+    gather_windows_pallas,
+    pad_lanes,
+    pad_months,
+)
+
+W = 60
+N_FIRMS = 8
+N_FEAT = 3  # fp = 4 packed
+
+
+def _packed_panel(T, seed=0):
+    """Unpadded packed panel [N, T, F+1] with ragged validity."""
+    rng = np.random.default_rng(seed)
+    feats = rng.standard_normal((N_FIRMS, T, N_FEAT)).astype(np.float32)
+    valid = rng.random((N_FIRMS, T)) < 0.8
+    valid[:, -1] = True  # keep the newest month observable somewhere
+    xm = np.concatenate([feats, valid[..., None].astype(np.float32)], -1)
+    # Zero invalid features like the real packed panel does not — the
+    # gather contract zero-fills masked steps itself, so leave raw noise
+    # in to catch any mask slip.
+    return jnp.asarray(xm)
+
+
+def _anchors(T):
+    """[D] anchor months: the whole tail residue + young + mid anchors."""
+    tail = [T - 1, T - 2, T - 5, T - 8, T - 9]
+    other = [W - 2, 10, T // 2]  # young (clamp+roll) and mid
+    return jnp.asarray(sorted({t for t in tail + other if 0 <= t < T}),
+                       dtype=jnp.int32)
+
+
+@pytest.mark.parametrize("T", [600, 601, 604, 613, 62, 64])
+def test_parity_with_xla_gather(T):
+    """Exact parity for every anchor placement, T % 8 in {0, 1, 4, 5, 6};
+    T in {62, 64} exercises the clamped near-window-length span
+    (w_pad == padded T, max_start8 == 0)."""
+    xm = _packed_panel(T, seed=T)
+    ti = _anchors(T)
+    D = ti.shape[0]
+    rng = np.random.default_rng(T + 1)
+    fi = jnp.asarray(rng.integers(0, N_FIRMS, size=(D, 4)), dtype=jnp.int32)
+
+    x_ref, m_ref = gather_windows_packed(xm, fi, ti, W)
+    x, m = gather_windows_pallas(xm, fi, ti, W)
+
+    np.testing.assert_array_equal(np.asarray(m), np.asarray(m_ref))
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(x_ref))
+
+
+def test_parity_on_prepadded_panel():
+    """The zero-copy production path: panel stored month+lane padded."""
+    T = 601
+    xm = _packed_panel(T, seed=3)
+    xm_pad = pad_months(pad_lanes(xm))
+    assert xm_pad.shape[1] % 8 == 0 and xm_pad.shape[2] % 128 == 0
+    ti = _anchors(T)
+    fi = jnp.asarray(
+        np.random.default_rng(4).integers(0, N_FIRMS, (ti.shape[0], 4)),
+        dtype=jnp.int32)
+    x_ref, m_ref = gather_windows_packed(xm, fi, ti, W)
+    x, m = gather_windows_pallas(xm_pad, fi, ti, W, fp=N_FEAT + 1)
+    np.testing.assert_array_equal(np.asarray(m), np.asarray(m_ref))
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(x_ref))
+
+
+def test_tail_anchor_fetches_newest_month():
+    """Direct regression for the pre-fix failure: the anchor month itself
+    (last position of the window) must hold the anchor's data for anchors
+    in the unaligned tail residue."""
+    T = 601  # T % 8 == 1: anchor T-1 was unreachable before month padding
+    xm = _packed_panel(T, seed=7)
+    ti = jnp.asarray([T - 1], dtype=jnp.int32)
+    fi = jnp.asarray([[0, 1, 2, 3]], dtype=jnp.int32)
+    x, m = gather_windows_pallas(xm, fi, ti, W)
+    for j, f in enumerate([0, 1, 2, 3]):
+        if bool(xm[f, T - 1, -1]):
+            np.testing.assert_array_equal(
+                np.asarray(x[0, j, -1]), np.asarray(xm[f, T - 1, :N_FEAT]))
+            assert bool(m[0, j, -1])
+
+
+def test_aligned_span_contract():
+    # Unpadded month counts are rejected outright.
+    assert _aligned_span(W, 601) is None
+    assert _aligned_span(W, 613) is None
+    # Padded counts give a span whose slack covers any 8-phase + clamp.
+    span = _aligned_span(W, 608)
+    assert span is not None
+    w_pad, max_start8 = span
+    assert w_pad - W >= 7 and max_start8 == 608 - w_pad
+    assert max_start8 % 8 == 0
+    # Near-window-length panels clamp the span to the whole (padded) month
+    # axis and stay on the fast path (max_start8 == 0 ⇒ off <= w_pad - W).
+    assert _aligned_span(W, 64) == (64, 0)
+    # Panels shorter than the window fall back.
+    assert _aligned_span(W, 56) is None
+
+
+def test_device_panel_lane_pad_pads_months():
+    T = 601
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((N_FIRMS, T, N_FEAT)).astype(np.float32)
+    valid = np.ones((N_FIRMS, T), bool)
+    panel = Panel(
+        features=feats, valid=valid,
+        targets=np.zeros((N_FIRMS, T), np.float32),
+        target_valid=valid.copy(),
+        returns=np.zeros((N_FIRMS, T), np.float32),
+        dates=np.arange(T, dtype=np.int32),
+        firm_ids=np.arange(N_FIRMS, dtype=np.int32),
+        feature_names=[f"f{i}" for i in range(N_FEAT)],
+    )
+    dev = device_panel(panel, raw=False, lane_pad=True)
+    assert dev["xm"].shape[1] % 8 == 0
+    assert dev["xm"].shape[2] % 128 == 0
+    # Phantom months are invalid (zero validity column).
+    assert not np.asarray(dev["xm"][:, T:, N_FEAT]).any()
+    # resolve_gather_impl must agree that the padded panel is usable
+    # (it only returns "pallas" on a real TPU, but must not trip on the
+    # aligned-span check for any T residue).
+    assert resolve_gather_impl("auto", None, panel, W) in ("xla", "pallas")

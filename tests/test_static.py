@@ -1,0 +1,56 @@
+"""Minimal static-analysis pass (no mypy/pyright in this environment).
+
+``from __future__ import annotations`` keeps a module importable even when
+an annotation references an un-imported name (the string is never
+evaluated) — until someone calls ``typing.get_type_hints`` and gets a
+``NameError``. This walks every module in the package and force-resolves
+every class's annotations, so missing-typing-import bugs fail CI instead
+of lurking (a real one shipped in data/panel.py in round 1).
+"""
+
+import importlib
+import inspect
+import pkgutil
+import typing
+
+import lfm_quant_tpu
+
+
+def _walk_modules():
+    yield lfm_quant_tpu
+    for info in pkgutil.walk_packages(lfm_quant_tpu.__path__,
+                                      prefix="lfm_quant_tpu."):
+        if info.name.rsplit(".", 1)[-1] == "_panel_native":
+            continue  # ctypes .so, not a Python extension module
+        yield importlib.import_module(info.name)
+
+
+def test_all_annotations_resolve():
+    failures = []
+    for mod in _walk_modules():
+        for name, obj in vars(mod).items():
+            if not inspect.isclass(obj) or obj.__module__ != mod.__name__:
+                continue
+            try:
+                typing.get_type_hints(obj)
+            except Exception as e:  # noqa: BLE001 - report all resolution bugs
+                failures.append(f"{mod.__name__}.{name}: {type(e).__name__}: {e}")
+        # Module-level annotations too (rare but same failure class).
+        try:
+            typing.get_type_hints(mod)
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"{mod.__name__} (module): {e}")
+    assert not failures, "unresolvable annotations:\n" + "\n".join(failures)
+
+
+def test_public_functions_annotations_resolve():
+    failures = []
+    for mod in _walk_modules():
+        for name, obj in vars(mod).items():
+            if not inspect.isfunction(obj) or obj.__module__ != mod.__name__:
+                continue
+            try:
+                typing.get_type_hints(obj)
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"{mod.__name__}.{name}: {type(e).__name__}: {e}")
+    assert not failures, "unresolvable annotations:\n" + "\n".join(failures)
