@@ -161,26 +161,32 @@ def get_preset(name: str) -> RunConfig:
         ) from None
 
 
-def model_kwargs(cfg: RunConfig, mesh=None) -> Tuple[str, Dict[str, Any]]:
+def model_kwargs(cfg: RunConfig, mesh=None,
+                 force_xla_scan: bool = False) -> Tuple[str, Dict[str, Any]]:
     """Resolve ModelConfig into build_model(kind, **kwargs) arguments.
 
-    ``mesh`` is the trainer's GSPMD mesh (or None): "auto" scan_impl picks
-    the fused Pallas recurrence only when the model runs un-partitioned on
-    a real TPU — under a mesh the XLA scan stays, because a pallas_call
-    cannot be split by the partitioner.
+    "auto" scan_impl picks the fused Pallas recurrence on a real TPU. A
+    mesh does not disqualify it: train steps run inside ``shard_map``
+    whenever a mesh exists (train/loop.py), where each shard is locally
+    un-partitioned and a pallas_call is legal. ``force_xla_scan=True``
+    overrides to the GSPMD-partitionable ``lax.scan`` — trainers use it to
+    build the eval-forward model, which stays outside shard_map.
     """
     import jax
     import jax.numpy as jnp
 
+    del mesh  # kept in the signature: callers resolve per execution context
     kw = dict(cfg.model.kwargs)
     if cfg.model.bf16:
         kw["dtype"] = jnp.bfloat16
     if cfg.model.heteroscedastic or cfg.optim.loss == "nll":
         kw["heteroscedastic"] = True
-    if cfg.model.kind in ("lstm", "gru") and "scan_impl" not in kw:
-        impl = cfg.model.scan_impl
-        if impl == "auto":
-            impl = ("pallas" if mesh is None
-                    and jax.default_backend() == "tpu" else "xla")
-        kw["scan_impl"] = impl
+    if cfg.model.kind in ("lstm", "gru"):
+        if "scan_impl" not in kw:
+            impl = cfg.model.scan_impl
+            if impl == "auto":
+                impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+            kw["scan_impl"] = impl
+        if force_xla_scan:
+            kw["scan_impl"] = "xla"
     return cfg.model.kind, kw

@@ -291,8 +291,14 @@ class DateBatchSampler:
 def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int) -> str:
     """Resolve a gather_impl config ("auto"|"xla"|"pallas") against the
     execution context: the Pallas DMA gather (ops/pallas_gather.py) needs
-    a real TPU, an un-partitioned step (pallas is opaque to GSPMD), and a
-    panel long enough for an aligned DMA span."""
+    a real TPU and a panel long enough for an aligned DMA span.
+
+    A mesh no longer disqualifies the fast path: train steps run inside
+    ``shard_map`` whenever a mesh exists (train/loop.py), where each shard
+    is locally un-partitioned and runs its own pallas_call. Only the eval
+    forward stays GSPMD-partitioned under a mesh — trainers route it to
+    the XLA gather separately (``Trainer._eval_gather_impl``).
+    """
     import jax
 
     from lfm_quant_tpu.ops.pallas_gather import _aligned_span, padded_months
@@ -301,7 +307,8 @@ def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int) -> str:
         raise ValueError(f"gather_impl must be auto|xla|pallas, got {impl!r}")
     if impl != "auto":
         return impl
-    ok = (jax.default_backend() == "tpu" and mesh is None
+    del mesh  # kept in the signature: callers resolve per execution context
+    ok = (jax.default_backend() == "tpu"
           and panel.n_months >= window
           and _aligned_span(window, padded_months(panel.n_months)) is not None)
     return "pallas" if ok else "xla"
@@ -340,19 +347,29 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
         [panel.features, panel.valid[..., None].astype(panel.features.dtype)],
         axis=-1,
     )
+    # Host→device bytes are the scarce resource (the axon tunnel moves
+    # ~MBs/sec): cast to the compute dtype ON THE HOST (ml_dtypes handles
+    # bf16 in numpy) so the wire carries 2-byte elements, and apply the
+    # 128-lane/8-month pallas padding ON THE DEVICE so the wire never
+    # carries padding (6× fewer bytes at 20 features).
+    if compute_dtype is not None:
+        import ml_dtypes  # numpy bf16 etc. — ships with jax
+
+        xm = xm.astype(ml_dtypes.bfloat16 if compute_dtype == jnp.bfloat16
+                       else compute_dtype)
+    xm_dev = put(xm)
     if lane_pad:
         from lfm_quant_tpu.ops.pallas_gather import padded_lanes, padded_months
 
         pad_f = padded_lanes(xm.shape[-1]) - xm.shape[-1]
         pad_t = padded_months(xm.shape[1]) - xm.shape[1]
         if pad_f or pad_t:
-            xm = np.pad(xm, ((0, 0), (0, pad_t), (0, pad_f)))
-    if compute_dtype is not None:
-        xm = jnp.asarray(xm).astype(compute_dtype)
+            xm_dev = put(jnp.pad(
+                xm_dev, ((0, 0), (0, pad_t), (0, pad_f))))
     dev = {
         "targets": put(panel.targets),
         "target_valid": put(panel.target_valid),
-        "xm": put(xm),
+        "xm": xm_dev,
     }
     if raw:
         dev["features"] = put(panel.features)
@@ -448,6 +465,7 @@ def gather_windows_packed(
     firm_idx: jax.Array,
     time_idx: jax.Array,
     window: int,
+    fp: Optional[int] = None,
 ):
     """Hot-path window gather over the packed panel (``device_panel``'s
     ``xm``: ``[N, T, F+1]`` with validity as the last column).
@@ -458,13 +476,20 @@ def gather_windows_packed(
     including the caller-must-chunk caveat for large leading axes.
     Returns ``(x [D, Bf, W, F], m [D, Bf, W] bool)`` with ``x`` in
     ``xm.dtype`` (store bf16 for bf16 models — they cast inputs anyway).
+
+    ``fp``: the LOGICAL packed width (features + validity column). Pass it
+    when ``xm`` is lane-padded for the Pallas DMA gather
+    (``device_panel(..., lane_pad=True)``) — the validity column then sits
+    at ``fp - 1``, not at the (zero-padding) last column.
     """
+    fp = fp or xm.shape[-1]
     if not (_is_date_layout(firm_idx, time_idx) and xm.shape[1] >= window):
         return gather_windows(
-            xm[..., :-1], xm[..., -1] != 0, firm_idx, time_idx, window)
-    rows = xm[firm_idx]  # [D, Bf, T, F+1] contiguous row gather
+            xm[..., :fp - 1], xm[..., fp - 1] != 0, firm_idx, time_idx,
+            window)
+    rows = xm[firm_idx]  # [D, Bf, T, Fp] contiguous row gather
     return _slice_windows(
-        rows[..., :-1], rows[..., -1] != 0, time_idx, window)
+        rows[..., :fp - 1], rows[..., fp - 1] != 0, time_idx, window)
 
 
 def gather_targets(targets: jax.Array, firm_idx: jax.Array, time_idx: jax.Array):

@@ -1,7 +1,9 @@
 """Losses and metrics: masked regression losses, cross-sectional rank-IC."""
 
 from lfm_quant_tpu.ops.losses import (
+    finalize_loss,
     gaussian_nll,
+    make_loss_parts,
     masked_huber,
     masked_mse,
     rank_ic_loss,
@@ -15,6 +17,8 @@ __all__ = [
     "gaussian_nll",
     "soft_rank",
     "rank_ic_loss",
+    "make_loss_parts",
+    "finalize_loss",
     "pearson_ic",
     "spearman_ic",
 ]

@@ -91,3 +91,55 @@ def rank_ic_loss(pred, target, w, temperature: float = 0.5):
     tr = soft_rank(target, w, temperature=1e-3)
     ic = _center_corr(pr, tr, w.astype(pred.dtype))
     return -ic.mean()
+
+
+# ---- numerator/denominator decompositions ---------------------------------
+#
+# Every loss above is a ratio of two data-sums: a weighted error sum over a
+# normalizer (total weight, or month count for rank-IC). Data-parallel
+# training under ``shard_map`` needs the two sums SEPARATELY so the global
+# loss can be assembled with one psum per part:
+#
+#     loss = psum(num_local) / psum(den_local)
+#
+# Normalizing per shard first would weight shards equally regardless of how
+# much real (w>0) data each holds — wrong whenever padding is uneven. The
+# ``finalize_loss`` epsilon matches ``_weighted_mean``'s, so
+# ``finalize_loss(*parts(out, y, w))`` == the plain loss exactly.
+
+
+def finalize_loss(num, den):
+    """num/den with _weighted_mean's zero-protection."""
+    return num / jnp.maximum(den, 1e-12)
+
+
+def _sum_parts(errs, w):
+    w = w.astype(errs.dtype)
+    return (errs * w).sum(), w.sum()
+
+
+def make_loss_parts(name: str):
+    """Loss name → fn(out, y, w) -> (num, den) with
+    ``finalize_loss(num, den) == make_loss_fn(name)(out, y, w)``."""
+    if name == "mse":
+        return lambda out, y, w: _sum_parts((out - y) ** 2, w)
+    if name == "huber":
+        def huber_parts(out, y, w, delta=1.0):
+            err = jnp.abs(out - y)
+            quad = jnp.minimum(err, delta)
+            return _sum_parts(0.5 * quad**2 + delta * (err - quad), w)
+        return huber_parts
+    if name == "nll":
+        def nll_parts(out, y, w):
+            mean, log_var = out
+            nll = 0.5 * (log_var + (y - mean) ** 2 * jnp.exp(-log_var))
+            return _sum_parts(nll, w)
+        return nll_parts
+    if name == "rank_ic":
+        def rank_ic_parts(out, y, w, temperature=0.5):
+            pr = soft_rank(out, w, temperature)
+            tr = soft_rank(y, w, temperature=1e-3)
+            ic = _center_corr(pr, tr, w.astype(out.dtype))
+            return (-ic).sum(), jnp.asarray(ic.size, ic.dtype)
+        return rank_ic_parts
+    raise ValueError(f"unknown loss {name!r}; use mse|huber|rank_ic|nll")

@@ -2,6 +2,8 @@
 sequence/context parallelism (ring attention over a 'seq' axis)."""
 
 from lfm_quant_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SEED_AXIS,
     batch_sharding,
     make_mesh,
     replicated,
@@ -17,6 +19,8 @@ from lfm_quant_tpu.parallel.ring import (
 )
 
 __all__ = [
+    "SEED_AXIS",
+    "DATA_AXIS",
     "make_mesh",
     "replicated",
     "batch_sharding",

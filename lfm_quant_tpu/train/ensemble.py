@@ -26,6 +26,7 @@ panel). TPU-native re-expression (prescribed at BASELINE.json:5):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import os
 from typing import Any, Dict, Optional, Tuple
@@ -36,15 +37,21 @@ import numpy as np
 
 from lfm_quant_tpu.config import RunConfig
 from lfm_quant_tpu.data.panel import Panel, PanelSplits
-from lfm_quant_tpu.data.windows import DateBatchSampler, device_panel
+from lfm_quant_tpu.data.windows import DateBatchSampler
 from lfm_quant_tpu.parallel import (
+    DATA_AXIS,
+    SEED_AXIS,
     make_mesh,
-    replicated,
     shard_batch,
     state_sharding,
 )
 from lfm_quant_tpu.train.checkpoint import CheckpointManager
-from lfm_quant_tpu.train.loop import FitHarness, TrainState, Trainer
+from lfm_quant_tpu.train.loop import (
+    FitHarness,
+    TrainState,
+    Trainer,
+    restore_state_dict,
+)
 from lfm_quant_tpu.utils.logging import MetricsLogger
 from lfm_quant_tpu.utils.profiling import StepTimer
 
@@ -62,14 +69,10 @@ class EnsembleTrainer:
         self.echo = echo
         self.n_seeds = cfg.n_seeds
 
-        # The single-seed Trainer provides the model, loss, optimizer and
-        # jit-free step/forward impls that we vmap (build_data=False: we
-        # do the panel device transfer ourselves, under the ensemble mesh).
-        self.inner = Trainer(cfg, splits, run_dir=None, build_data=False)
-        self.window = self.inner.window
-
-        # Mesh: seed axis as large as divides both n_seeds and the device
-        # count; data axis from config when devices remain.
+        # Mesh FIRST: seed axis as large as divides both n_seeds and the
+        # device count; data axis from config when devices remain. The
+        # inner Trainer then resolves model / gather / panel exactly once
+        # against this mesh (no post-hoc attribute surgery).
         n_dev = jax.device_count()
         n_seed_mesh = 1
         for cand in range(min(self.n_seeds, n_dev), 0, -1):
@@ -81,29 +84,16 @@ class EnsembleTrainer:
             make_mesh(n_seed_mesh, n_data)
             if n_seed_mesh * n_data > 1 else None
         )
-        # The ensemble's mesh may differ from the inner trainer's (which
-        # was built device-count-blind to the seed axis) — re-resolve the
-        # "auto" scan_impl and gather_impl against OUR mesh and rebuild
-        # the shared model. vmap over the seed axis composes with the
-        # Pallas kernels; a GSPMD mesh does not.
-        from lfm_quant_tpu.config import model_kwargs
-        from lfm_quant_tpu.data.windows import resolve_gather_impl
-        from lfm_quant_tpu.models import build_model
 
-        kind, kwargs = model_kwargs(cfg, self.mesh)
-        self.inner.model = build_model(kind, **kwargs)
-        self.inner._gather_impl = resolve_gather_impl(
-            cfg.data.gather_impl, self.mesh, splits.panel, cfg.data.window)
-
-        # ONE HBM-resident panel serves the ensemble and the inner trainer
-        # (PanelSplits are anchor ranges over a shared panel, not slices);
-        # lane-padded iff the re-resolved gather (below) is the Pallas DMA
-        # kernel.
-        self.dev = device_panel(
-            splits.panel, replicated(self.mesh) if self.mesh else None,
-            compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
-            raw=False, lane_pad=self.inner._gather_impl == "pallas")
-        self.inner.dev = self.dev
+        # The single-seed Trainer provides the model, loss, optimizer,
+        # jit-free step/forward impls that we vmap, AND the HBM-resident
+        # panel (ONE copy serves ensemble + inner: PanelSplits are anchor
+        # ranges over a shared panel, not slices). Under the mesh its
+        # train model keeps the Pallas kernels (the step runs inside
+        # shard_map below) while its eval model/gather are GSPMD-safe.
+        self.inner = Trainer(cfg, splits, run_dir=None, mesh=self.mesh)
+        self.window = self.inner.window
+        self.dev = self.inner.dev
 
         d = cfg.data
         self.samplers = [
@@ -116,13 +106,49 @@ class EnsembleTrainer:
         ]
         self.val_sampler = self.inner.val_sampler
 
-        # vmap the single-seed impls over the stacked state + index batch;
-        # the device panel is broadcast (in_axes=None).
-        self._vstep = jax.vmap(self.inner._step_impl, in_axes=(0, None, 0, 0, 0))
-        self._jit_step = jax.jit(self._vstep)
-        self._jit_multi_step = jax.jit(self._multi_step_impl)
+        # vmap the single-seed impls over the stacked state + index batch
+        # (device panel broadcast, in_axes=None); under a mesh, shard_map
+        # the vmapped step over (seed × data) — each shard trains its local
+        # seed block on its local dates with Pallas kernels intact, psum
+        # over 'data' only (seeds are independent).
+        if self.mesh is None:
+            self._vstep = jax.vmap(
+                self.inner._step_impl, in_axes=(0, None, 0, 0, 0))
+            self._jit_step = jax.jit(self._vstep)
+            self._jit_multi_step = jax.jit(self._multi_step_impl)
+        else:
+            self._vstep = jax.vmap(
+                functools.partial(self.inner._step_impl, axis=DATA_AXIS),
+                in_axes=(0, None, 0, 0, 0))
+            self._jit_step = jax.jit(self._shard_mapped(
+                self._step_shards, steps_axis=False))
+            self._jit_multi_step = jax.jit(self._shard_mapped(
+                self._multi_step_impl, steps_axis=True))
         self._jit_forward = jax.jit(
             jax.vmap(self.inner._forward_impl, in_axes=(0, None, None, None, None))
+        )
+
+    def _step_shards(self, state, dev, fi, ti, w):
+        return self._vstep(state, dev, fi, ti, w)
+
+    def _shard_mapped(self, impl, steps_axis: bool):
+        """shard_map an ensemble step over (seed × data): the stacked
+        state shards its leading seed axis; [.., S, D, Bf] index batches
+        shard seed and date axes; the panel replicates. out_specs mark the
+        state seed-sharded and (implicitly) data-replicated — true because
+        the psum'd gradients make every data-shard's update identical
+        (check_vma=False: replication is mathematical, not provable)."""
+        from jax.sharding import PartitionSpec as P
+
+        batch = (P(None, SEED_AXIS, DATA_AXIS) if steps_axis
+                 else P(SEED_AXIS, DATA_AXIS))
+        metrics = P(None, SEED_AXIS) if steps_axis else P(SEED_AXIS)
+        return jax.shard_map(
+            impl,
+            mesh=self.mesh,
+            in_specs=(P(SEED_AXIS), P(), batch, batch, batch),
+            out_specs=(P(SEED_AXIS), metrics),
+            check_vma=False,
         )
 
     def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w):
@@ -321,7 +347,7 @@ def load_ensemble(run_dir: str, panel: Optional[Panel] = None):
     trainer = EnsembleTrainer(cfg, splits, run_dir=run_dir)
     state = trainer.init_state()
     ckpt = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))
-    restored = ckpt.restore(state._asdict())
+    restored = restore_state_dict(ckpt, state._asdict())
     ckpt.close()
     trainer.state = trainer._commit_state(TrainState(**restored))
     return trainer, splits

@@ -36,12 +36,10 @@ from lfm_quant_tpu.data.windows import (
     resolve_gather_impl,
 )
 from lfm_quant_tpu.models import build_model
-from lfm_quant_tpu.parallel import make_mesh, replicated, shard_batch
+from lfm_quant_tpu.parallel import DATA_AXIS, make_mesh, replicated, shard_batch
 from lfm_quant_tpu.ops import (
-    gaussian_nll,
-    masked_huber,
-    masked_mse,
-    rank_ic_loss,
+    finalize_loss,
+    make_loss_parts,
     spearman_ic,
 )
 from lfm_quant_tpu.train.checkpoint import CheckpointManager
@@ -53,6 +51,12 @@ class TrainState(NamedTuple):
     params: Any
     opt_state: Any
     step: jax.Array
+    # Raw uint32 base key for stochastic regularization (dropout). CONSTANT
+    # through training — per-step keys are derived as fold_in(rng, step)
+    # (+ shard index under shard_map), so resume-from-checkpoint replays
+    # the exact dropout stream. Per-ensemble-member init keys make member
+    # dropout streams independent.
+    rng: jax.Array
 
 
 def make_loss_fn(name: str) -> Callable:
@@ -60,21 +64,39 @@ def make_loss_fn(name: str) -> Callable:
 
     ``outputs`` is the model's head output: [D, Bf] for point heads,
     (mean, log_var) tuple for the heteroscedastic head (required by "nll").
+    Derived from ``make_loss_parts`` so the scalar loss and the sharded
+    num/den decomposition (train/loop.py psum assembly) cannot drift.
     """
-    if name == "mse":
-        return lambda out, y, w: masked_mse(out, y, w)
-    if name == "huber":
-        return lambda out, y, w: masked_huber(out, y, w)
-    if name == "rank_ic":
-        return lambda out, y, w: rank_ic_loss(out, y, w)
-    if name == "nll":
-        return lambda out, y, w: gaussian_nll(out[0], out[1], y, w)
-    raise ValueError(f"unknown loss {name!r}; use mse|huber|rank_ic|nll")
+    parts = make_loss_parts(name)
+    return lambda out, y, w: finalize_loss(*parts(out, y, w))
 
 
 def _point_forecast(out):
     """Point forecast from either head type (mean for heteroscedastic)."""
     return out[0] if isinstance(out, tuple) else out
+
+
+def restore_state_dict(mgr: CheckpointManager,
+                       abstract: Dict[str, Any]) -> Dict[str, Any]:
+    """Restore a TrainState dict with legacy-checkpoint tolerance: states
+    checkpointed before the ``rng`` field existed restore without it and
+    take the freshly-initialized key (the dropout stream then differs
+    from an unbroken run — harmless; pre-rng checkpoints trained without
+    live dropout anyway)."""
+    try:
+        return mgr.restore(abstract)
+    except Exception as e:
+        if "rng" not in abstract:
+            raise
+        legacy = {k: v for k, v in abstract.items() if k != "rng"}
+        try:
+            restored = mgr.restore(legacy)
+        except Exception:
+            # The legacy tree fails too — the original failure was real
+            # corruption, not the missing rng leaf; don't mask it.
+            raise e
+        restored["rng"] = abstract["rng"]
+        return restored
 
 
 def load_progress(run_dir: str) -> Dict[str, Any]:
@@ -133,7 +155,7 @@ class FitHarness:
         step = self.latest_mgr.latest_step()
         if step is None:
             return None
-        restored = self.latest_mgr.restore(abstract_state_dict)
+        restored = restore_state_dict(self.latest_mgr, abstract_state_dict)
         try:
             prog = load_progress(self.run_dir)
             self.start_epoch = prog["epoch"] + 1
@@ -185,7 +207,7 @@ class FitHarness:
         best = None
         if (self.best_mgr and self.best_epoch >= 0
                 and self.best_mgr.latest_step() is not None):
-            best = self.best_mgr.restore(abstract_state_dict)
+            best = restore_state_dict(self.best_mgr, abstract_state_dict)
         if self.latest_mgr:
             self.latest_mgr.close()
             self.best_mgr.close()
@@ -201,11 +223,13 @@ class Trainer:
 
     def __init__(self, cfg: RunConfig, splits: PanelSplits,
                  run_dir: Optional[str] = None, echo: bool = False,
-                 build_data: bool = True):
-        """``build_data=False`` skips the panel device transfer (the large
-        allocation) — for wrappers (EnsembleTrainer) that provide their own
-        device panel. Samplers are always built: the LR schedule needs
-        batches_per_epoch, and the ensemble reuses val_sampler."""
+                 mesh: Any = "auto"):
+        """``mesh``: "auto" builds the single-seed (1 × n_data_shards)
+        data mesh; wrappers pass their own mesh (EnsembleTrainer's
+        seed × data) or None, so model/gather/panel resolution happens
+        exactly once against the mesh that will actually run the step
+        (the ensemble then shares this trainer's device panel).
+        """
         self.cfg = cfg
         self.splits = splits
         self.run_dir = run_dir
@@ -213,22 +237,40 @@ class Trainer:
         d = cfg.data
 
         self.loss_fn = make_loss_fn(cfg.optim.loss)
+        self.loss_parts = make_loss_parts(cfg.optim.loss)
         self.window = d.window
+        # Stochastic-regularization flag: when dropout is configured, the
+        # train step threads a per-step rng + deterministic=False through
+        # model.apply (eval stays deterministic). Without it the rng plumb
+        # is skipped entirely, keeping the jitted graph unchanged.
+        self._needs_rng = float(cfg.model.kwargs.get("dropout") or 0.0) > 0.0
 
         # Data-parallel mesh (SURVEY.md §8 step 8): shard the DATE axis of
         # each batch so monthly cross-sections stay shard-local for rank-IC.
         # Degrades gracefully to fewer devices than configured shards.
-        n_data = max(1, min(cfg.n_data_shards, jax.device_count()))
+        if mesh == "auto":
+            n_data = max(1, min(cfg.n_data_shards, jax.device_count()))
+            mesh = make_mesh(1, n_data) if n_data > 1 else None
+        self.mesh = mesh
+        n_data = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
         if d.dates_per_batch % n_data:
             raise ValueError(
                 f"dates_per_batch={d.dates_per_batch} must be divisible by "
                 f"n_data_shards={n_data}")
-        self.mesh = make_mesh(1, n_data) if n_data > 1 else None
 
-        # Model AFTER the mesh: "auto" scan_impl depends on it (Pallas
-        # recurrence only when un-partitioned — see config.model_kwargs).
-        kind, kwargs = model_kwargs(cfg, self.mesh)
+        # Train model: the Pallas fused recurrence survives the mesh
+        # because the train step runs inside shard_map (locally
+        # un-partitioned per shard). The eval forward stays GSPMD-
+        # partitioned, so under a mesh it gets a twin model on the XLA
+        # scan — parameter trees are identical between scan impls
+        # (models/rnn.py _GateKernel path aliasing), so params interchange.
+        kind, kwargs = model_kwargs(cfg)
         self.model = build_model(kind, **kwargs)
+        if self.mesh is not None:
+            ekind, ekwargs = model_kwargs(cfg, force_xla_scan=True)
+            self.eval_model = build_model(ekind, **ekwargs)
+        else:
+            self.eval_model = self.model
 
         self.train_sampler = DateBatchSampler(
             splits.panel, d.window, d.dates_per_batch, d.firms_per_date,
@@ -241,20 +283,21 @@ class Trainer:
             min_cross_section=1, date_range=splits.val_range,
         )
         # Gather implementation (Pallas DMA gather needs a lane-padded
-        # panel, so it must be resolved before the device transfer).
+        # panel, so it must be resolved before the device transfer). Eval
+        # runs outside shard_map → XLA gather whenever a mesh exists (it
+        # reads the lane-padded panel via the logical fp width).
         self._gather_impl = resolve_gather_impl(
             d.gather_impl, self.mesh, splits.panel, d.window)
+        self._eval_gather_impl = (
+            self._gather_impl if self.mesh is None else "xla")
         self._fp = splits.panel.n_features + 1  # logical packed width
-        if build_data:
-            # ONE device-resident copy of the full panel serves training,
-            # eval and inference (PanelSplits are anchor ranges, not slices).
-            panel_sharding = replicated(self.mesh) if self.mesh else None
-            self.dev = device_panel(
-                splits.panel, panel_sharding,
-                compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
-                raw=False, lane_pad=self._gather_impl == "pallas")
-        else:
-            self.dev = None
+        # ONE device-resident copy of the full panel serves training,
+        # eval and inference (PanelSplits are anchor ranges, not slices).
+        panel_sharding = replicated(self.mesh) if self.mesh else None
+        self.dev = device_panel(
+            splits.panel, panel_sharding,
+            compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
+            raw=False, lane_pad=self._gather_impl == "pallas")
 
         steps_per_epoch = self.train_sampler.batches_per_epoch()
         total_steps = max(1, steps_per_epoch * cfg.optim.epochs)
@@ -267,57 +310,116 @@ class Trainer:
             optax.adamw(schedule, weight_decay=cfg.optim.weight_decay),
         )
 
-        self._jit_step = jax.jit(self._step_impl)
-        self._jit_multi_step = jax.jit(self._multi_step_impl)
+        if self.mesh is None:
+            self._jit_step = jax.jit(self._step_impl)
+            self._jit_multi_step = jax.jit(self._multi_step_impl)
+        else:
+            # shard_map over the date axis: each shard gathers and runs the
+            # model locally (Pallas kernels legal), with explicit psums for
+            # the global loss/gradients — numerically the same weighted
+            # means GSPMD computed, up to reduction order.
+            self._jit_step = jax.jit(self._shard_mapped(
+                self._step_impl, steps_axis=False))
+            self._jit_multi_step = jax.jit(self._shard_mapped(
+                self._multi_step_impl, steps_axis=True))
         self._jit_forward = jax.jit(self._forward_impl)
+
+    def _shard_mapped(self, impl, steps_axis: bool):
+        """Wrap a step impl in shard_map over this trainer's mesh.
+
+        State and panel replicate (P()); index batches shard their date
+        axis. out_specs are P() because the psum'd gradients make every
+        shard's update identical (check_vma=False: the replication is
+        mathematical, not provable by the varying-axes checker)."""
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        batch = P(None, DATA_AXIS) if steps_axis else P(DATA_AXIS)
+        return jax.shard_map(
+            functools.partial(impl, axis=DATA_AXIS),
+            mesh=self.mesh,
+            in_specs=(P(), P(), batch, batch, batch),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
 
     # ---- jitted impls ------------------------------------------------
 
-    def _apply(self, params, x, m):
-        """Flatten [D, Bf] batch dims → one big MXU batch, reapply shape."""
+    def _apply(self, params, x, m, model=None, rng=None):
+        """Flatten [D, Bf] batch dims → one big MXU batch, reapply shape.
+
+        ``rng``: dropout key — training passes it when dropout is
+        configured (deterministic=False); eval never does."""
+        model = model or self.model
         lead = x.shape[:-2]
         xf = x.reshape((-1,) + x.shape[-2:])
         mf = m.reshape((-1,) + m.shape[-1:])
-        out = self.model.apply({"params": params}, xf, mf)
+        if rng is not None:
+            out = model.apply({"params": params}, xf, mf,
+                              deterministic=False, rngs={"dropout": rng})
+        else:
+            out = model.apply({"params": params}, xf, mf)
         if isinstance(out, tuple):
             return tuple(o.reshape(lead) for o in out)
         return out.reshape(lead)
 
-    def _gather(self, xm, firm_idx, time_idx):
+    def _gather(self, xm, firm_idx, time_idx, impl=None):
         """The resolved window gather (ops/pallas_gather.py DMA kernel or
-        the XLA row gather). NOTE: with the Pallas impl the device panel
-        is lane-padded — the XLA path must not read it (its validity
-        column position differs)."""
-        if self._gather_impl == "pallas":
+        the XLA row gather). Both read the panel through the logical
+        packed width ``fp`` — the panel may be lane-padded (Pallas)."""
+        impl = impl or self._gather_impl
+        if impl == "pallas":
             from lfm_quant_tpu.ops.pallas_gather import gather_windows_pallas
 
             return gather_windows_pallas(
                 xm, firm_idx, time_idx, self.window, fp=self._fp)
-        return gather_windows_packed(xm, firm_idx, time_idx, self.window)
+        return gather_windows_packed(
+            xm, firm_idx, time_idx, self.window, fp=self._fp)
 
     def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
-                   weight):
+                   weight, axis: Optional[str] = None):
+        """One train step. ``axis`` names the mesh axis this step runs
+        under inside shard_map (None = un-partitioned): the loss is a
+        ratio of data-sums, so the global value needs one psum per part,
+        and gradients psum across shards (replicated params)."""
+        step_rng = None
+        if self._needs_rng:
+            # Derived, never stored: resume replays the same stream; the
+            # shard index decorrelates dropout masks across data shards.
+            step_rng = jax.random.fold_in(state.rng, state.step)
+            if axis is not None:
+                step_rng = jax.random.fold_in(
+                    step_rng, jax.lax.axis_index(axis))
+
         def loss_of(params):
             x, m = self._gather(dev["xm"], firm_idx, time_idx)
             y = gather_targets(dev["targets"], firm_idx, time_idx)
-            out = self._apply(params, x, m)
-            return self.loss_fn(out, y, weight)
+            out = self._apply(params, x, m, rng=step_rng)
+            num, den = self.loss_parts(out, y, weight)
+            if axis is not None:
+                num = jax.lax.psum(num, axis)
+                den = jax.lax.psum(den, axis)
+            return finalize_loss(num, den)
 
         loss, grads = jax.value_and_grad(loss_of)(state.params)
+        if axis is not None:
+            grads = jax.lax.psum(grads, axis)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
-        return TrainState(params, opt_state, state.step + 1), {
+        return TrainState(params, opt_state, state.step + 1, state.rng), {
             "loss": loss, "grad_norm": gnorm,
         }
 
-    def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w):
+    def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w,
+                         axis: Optional[str] = None):
         """K training steps in ONE compiled dispatch: lax.scan over a
         [K, D, Bf] index stack. Per-step dispatch latency (25–30 ms on a
         tunneled device) would otherwise dwarf the ~ms of real compute per
         step; scanning an epoch inside jit removes it entirely."""
         def body(st, batch):
-            return self._step_impl(st, dev, *batch)
+            return self._step_impl(st, dev, *batch, axis=axis)
 
         return jax.lax.scan(body, state, (fi, ti, w))
 
@@ -343,9 +445,11 @@ class Trainer:
 
         def chunk(args):
             fi, ti, w = args
-            x, m = self._gather(dev["xm"], fi, ti)
+            x, m = self._gather(dev["xm"], fi, ti,
+                                impl=self._eval_gather_impl)
             y = gather_targets(dev["targets"], fi, ti)
-            pred = _point_forecast(self._apply(params, x, m))
+            pred = _point_forecast(
+                self._apply(params, x, m, model=self.eval_model))
             ic = spearman_ic(pred, y, w)
             se = (w * (pred.astype(jnp.float32) - y) ** 2).sum(axis=-1)
             return pred, ic, se, w.sum(axis=-1)
@@ -374,7 +478,11 @@ class Trainer:
         x = jnp.zeros((2, d.window, self.splits.panel.n_features), jnp.float32)
         m = jnp.ones((2, d.window), bool)
         params = self.model.init(rng, x, m)["params"]
-        return TrainState(params, self.tx.init(params), jnp.asarray(0))
+        # Raw uint32 key data (checkpoint-friendly); distinct from the init
+        # stream, and per-member under the ensemble's vmapped init.
+        state_rng = jax.random.key_data(jax.random.fold_in(rng, 0x0D0))
+        return TrainState(params, self.tx.init(params), jnp.asarray(0),
+                          state_rng)
 
     def _batch_args(self, b: WindowIndex, train: bool = False,
                     steps: bool = False):
@@ -551,7 +659,7 @@ def load_trainer(run_dir: str, panel: Optional[Panel] = None):
     trainer = Trainer(cfg, splits, run_dir=run_dir)
     state = trainer.init_state()
     ckpt = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))
-    restored = ckpt.restore(state._asdict())
+    restored = restore_state_dict(ckpt, state._asdict())
     ckpt.close()
     trainer.state = trainer._commit_state(TrainState(**restored))
     return trainer, splits

@@ -1,0 +1,126 @@
+"""Dropout is live in training (round-1 verdict item 5: a configured
+dropout>0 used to be silently ignored — Trainer never passed
+deterministic=False or an rng). Pins: dropout changes training losses,
+is deterministic per (state, step) for resume replay, stays OFF in eval,
+and composes with the shard_map mesh path and the vmapped ensemble."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+from lfm_quant_tpu.train import Trainer
+from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+
+def _cfg(tmp, dropout, n_shards=1, n_seeds=1):
+    return RunConfig(
+        name=f"drop{dropout}",
+        data=DataConfig(n_firms=120, n_months=150, n_features=5, window=12,
+                        dates_per_batch=8, firms_per_date=32),
+        model=ModelConfig(kind="mlp",
+                          kwargs={"hidden": (16,), "dropout": dropout}),
+        optim=OptimConfig(lr=1e-3, epochs=2, warmup_steps=5, loss="mse"),
+        seed=0,
+        n_seeds=n_seeds,
+        n_data_shards=n_shards,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=120, n_months=150, n_features=5, seed=31)
+
+
+@pytest.fixture(scope="module")
+def splits(panel):
+    return PanelSplits.by_date(panel, 197910, 198101)
+
+
+def test_dropout_changes_training_loss(splits, tmp_path):
+    t0 = Trainer(_cfg(tmp_path / "a", 0.0), splits)
+    t5 = Trainer(_cfg(tmp_path / "b", 0.5), splits)
+    assert not t0._needs_rng and t5._needs_rng
+    s0, s5 = t0.init_state(), t5.init_state()
+    # Same seed, no dropout params → identical initial params.
+    for a, b in zip(jax.tree.leaves(s0.params), jax.tree.leaves(s5.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    b = next(iter(t0.train_sampler.epoch(0)))
+    args = t0._batch_args(b)
+    _, m0 = t0._jit_step(s0, t0.dev, *args)
+    _, m5 = t5._jit_step(s5, t5.dev, *args)
+    assert float(m0["loss"]) != pytest.approx(float(m5["loss"]), rel=1e-6)
+
+
+def test_dropout_deterministic_per_step(splits, tmp_path):
+    """fold_in(rng, step) keys: replaying the same state+batch gives the
+    same loss (crash resume replays the identical dropout stream)."""
+    t = Trainer(_cfg(tmp_path, 0.5), splits)
+    s = t.init_state()
+    b = next(iter(t.train_sampler.epoch(0)))
+    args = t._batch_args(b)
+    _, m1 = t._jit_step(s, t.dev, *args)
+    _, m2 = t._jit_step(s, t.dev, *args)
+    assert float(m1["loss"]) == float(m2["loss"])
+    # ...but the NEXT step (step+1) draws a different mask.
+    s_next, _ = t._jit_step(s, t.dev, *args)
+    _, m3 = t._jit_step(s_next, t.dev, *args)
+    assert float(m3["loss"]) != float(m1["loss"])
+
+
+def test_eval_is_deterministic(splits, tmp_path):
+    """Dropout must be OFF in the eval forward: same params → same IC as
+    the no-dropout twin (identical eval graphs)."""
+    t0 = Trainer(_cfg(tmp_path / "a", 0.0), splits)
+    t5 = Trainer(_cfg(tmp_path / "b", 0.5), splits)
+    s = t5.init_state()
+    v0 = t0.evaluate(s.params)
+    v5 = t5.evaluate(s.params)
+    assert v0["ic"] == pytest.approx(v5["ic"], abs=1e-9)
+    assert v0["mse"] == pytest.approx(v5["mse"], rel=1e-9)
+
+
+def test_dropout_under_shard_map(splits, tmp_path):
+    """The rng plumb composes with the mesh path (axis_index fold)."""
+    t = Trainer(_cfg(tmp_path, 0.5, n_shards=8), splits)
+    assert t.mesh is not None
+    s = t.init_state()
+    b = next(iter(t.train_sampler.epoch(0)))
+    s, m = t._jit_step(s, t.dev, *t._batch_args(b, train=True))
+    assert np.isfinite(float(m["loss"]))
+    # Still deterministic given the same state.
+    _, m2 = t._jit_step(
+        t.init_state(), t.dev, *t._batch_args(b, train=True))
+    assert float(m2["loss"]) == pytest.approx(float(m["loss"]), rel=1e-6)
+
+
+def test_dropout_in_ensemble(splits, tmp_path):
+    """Vmapped members train with dropout (per-member rng from the
+    vmapped init) without error; losses stay finite."""
+    cfg = _cfg(tmp_path, 0.3, n_shards=2, n_seeds=4)
+    e = EnsembleTrainer(cfg, splits)
+    s = e.init_state()
+    # Per-member dropout streams are independent: the stacked state rng
+    # rows differ.
+    rngs = np.asarray(s.rng)
+    assert rngs.shape[0] == 4 and len({tuple(r) for r in rngs}) == 4
+    arrays = e._stacked_batch([smp.epoch(0) for smp in e.samplers])
+    s, m = e._jit_step(s, e.dev, *arrays)
+    assert np.isfinite(np.asarray(m["loss"])).all()
+
+
+def test_transformer_dropout_trains(splits, tmp_path):
+    cfg = _cfg(tmp_path, 0.2)
+    cfg = dataclasses.replace(cfg, model=ModelConfig(
+        kind="transformer",
+        kwargs={"dim": 16, "depth": 1, "heads": 2, "dropout": 0.2}))
+    t = Trainer(cfg, splits)
+    assert t._needs_rng
+    s = t.init_state()
+    b = next(iter(t.train_sampler.epoch(0)))
+    _, m = t._jit_step(s, t.dev, *t._batch_args(b))
+    assert np.isfinite(float(m["loss"]))

@@ -11,7 +11,9 @@ import pytest
 from scipy import stats
 
 from lfm_quant_tpu.ops import (
+    finalize_loss,
     gaussian_nll,
+    make_loss_parts,
     masked_huber,
     masked_mse,
     pearson_ic,
@@ -19,6 +21,26 @@ from lfm_quant_tpu.ops import (
     soft_rank,
     spearman_ic,
 )
+
+
+@pytest.mark.parametrize("name", ["mse", "huber", "rank_ic", "nll"])
+def test_loss_parts_reassemble_exactly(name):
+    """finalize_loss(*parts(out, y, w)) must equal the canonical loss —
+    the invariant the shard_map psum assembly (train/loop.py) rests on."""
+    rng = np.random.default_rng(5)
+    y = jnp.asarray(rng.standard_normal((4, 9)).astype(np.float32))
+    p = jnp.asarray(rng.standard_normal((4, 9)).astype(np.float32))
+    lv = jnp.asarray(rng.standard_normal((4, 9)).astype(np.float32))
+    w = jnp.asarray((rng.random((4, 9)) < 0.8).astype(np.float32))
+    out = (p, lv) if name == "nll" else p
+    ref = {
+        "mse": lambda: masked_mse(p, y, w),
+        "huber": lambda: masked_huber(p, y, w),
+        "rank_ic": lambda: rank_ic_loss(p, y, w),
+        "nll": lambda: gaussian_nll(p, lv, y, w),
+    }[name]()
+    got = finalize_loss(*make_loss_parts(name)(out, y, w))
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
 
 
 def test_masked_mse_ignores_padding():

@@ -119,3 +119,109 @@ def test_indivisible_batch_raises(panel, tmp_path):
         cfg, data=dataclasses.replace(cfg.data, dates_per_batch=6))
     with pytest.raises(ValueError, match="divisible"):
         Trainer(cfg, splits)
+
+
+def _pallas_cfg(n_shards, tmp, impls=("pallas", "pallas"), seed=0):
+    """LSTM config with explicit scan/gather impls ("pallas" runs the real
+    kernels in interpret mode on the CPU test platform)."""
+    scan_impl, gather_impl = impls
+    return RunConfig(
+        name=f"pl{n_shards}",
+        data=DataConfig(n_firms=120, n_months=160, n_features=5, window=12,
+                        dates_per_batch=8, firms_per_date=32,
+                        gather_impl=gather_impl),
+        model=ModelConfig(kind="lstm", kwargs={"hidden": 16},
+                          scan_impl=scan_impl),
+        optim=OptimConfig(lr=1e-3, epochs=2, warmup_steps=5,
+                          early_stop_patience=5, loss="mse"),
+        seed=seed,
+        n_data_shards=n_shards,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def lstm_panel():
+    return synthetic_panel(n_firms=120, n_months=160, n_features=5, seed=29)
+
+
+def test_shard_map_pallas_matches_single_device_xla(lstm_panel, tmp_path):
+    """THE mesh-survival property (round-1 verdict item 1): the fused
+    Pallas RNN + DMA gather running per-shard inside shard_map over an
+    8-way date mesh must reproduce single-device XLA training numerics."""
+    splits = PanelSplits.by_date(lstm_panel, 198001, 198201)
+
+    t_xla = Trainer(_pallas_cfg(1, tmp_path / "a", ("xla", "xla")), splits)
+    t_pal = Trainer(_pallas_cfg(8, tmp_path / "b", ("pallas", "pallas")),
+                    splits)
+    assert t_pal.mesh is not None and t_pal.mesh.shape["data"] == 8
+    assert t_pal._gather_impl == "pallas"
+    assert t_pal.model.scan_impl == "pallas"
+    # Eval stays GSPMD-safe under the mesh.
+    assert t_pal._eval_gather_impl == "xla"
+    assert t_pal.eval_model.scan_impl == "xla"
+
+    s_x, s_p = t_xla.init_state(), t_pal.init_state()
+    # Identical param trees between scan impls (checkpoint interchange).
+    for a, b in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_p.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+    for b in t_xla.train_sampler.epoch(0):
+        s_x, m_x = t_xla._jit_step(s_x, t_xla.dev, *t_xla._batch_args(b, train=True))
+        s_p, m_p = t_pal._jit_step(s_p, t_pal.dev, *t_pal._batch_args(b, train=True))
+    assert float(m_x["loss"]) == pytest.approx(float(m_p["loss"]), rel=1e-3)
+    for a, b in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_p.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5)
+    # The eval forward (GSPMD path, XLA twin model reading the lane-padded
+    # panel through fp) agrees across the two trainers.
+    v_x = t_xla.evaluate(s_x.params)
+    v_p = t_pal.evaluate(s_p.params)
+    assert v_x["ic"] == pytest.approx(v_p["ic"], abs=1e-3)
+
+
+def test_shard_map_multi_step_pallas(lstm_panel, tmp_path):
+    """The whole-epoch in-jit scan composes with shard_map + Pallas."""
+    splits = PanelSplits.by_date(lstm_panel, 198001, 198201)
+    t_xla = Trainer(_pallas_cfg(1, tmp_path / "a", ("xla", "xla")), splits)
+    t_pal = Trainer(_pallas_cfg(4, tmp_path / "b", ("pallas", "pallas")),
+                    splits)
+    s_x, s_p = t_xla.init_state(), t_pal.init_state()
+    b = t_xla.train_sampler.stacked_epoch(0)
+    s_x, m_x = t_xla._jit_multi_step(
+        s_x, t_xla.dev, *t_xla._batch_args(b, train=True, steps=True))
+    s_p, m_p = t_pal._jit_multi_step(
+        s_p, t_pal.dev, *t_pal._batch_args(b, train=True, steps=True))
+    np.testing.assert_allclose(np.asarray(m_x["loss"]),
+                               np.asarray(m_p["loss"]), rtol=1e-3, atol=1e-5)
+    for a, c in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_p.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-3, atol=1e-5)
+
+
+def test_ensemble_shard_map_pallas_matches_xla(lstm_panel, tmp_path):
+    """vmap(seeds) ∘ shard_map(seed × data) ∘ Pallas kernels: the stacked
+    ensemble step with per-shard Pallas must match the same ensemble on
+    XLA impls (same mesh), proving the fast path survives the full
+    target-topology composition."""
+    import dataclasses
+
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+    splits = PanelSplits.by_date(lstm_panel, 198001, 198201)
+    mk = lambda impls, sub: dataclasses.replace(  # noqa: E731
+        _pallas_cfg(2, tmp_path / sub, impls), n_seeds=4)
+    e_xla = EnsembleTrainer(mk(("xla", "xla"), "a"), splits)
+    e_pal = EnsembleTrainer(mk(("pallas", "pallas"), "b"), splits)
+    assert e_pal.mesh is not None
+    assert e_pal.mesh.shape == {"seed": 4, "data": 2}
+
+    s_x, s_p = e_xla.init_state(), e_pal.init_state()
+    fi, ti, w = e_pal._stacked_epoch(0)
+    s_x, m_x = e_xla._jit_multi_step(s_x, e_xla.dev, fi, ti, w)
+    s_p, m_p = e_pal._jit_multi_step(s_p, e_pal.dev, fi, ti, w)
+    np.testing.assert_allclose(np.asarray(m_x["loss"]),
+                               np.asarray(m_p["loss"]), rtol=1e-3, atol=1e-5)
+    for a, c in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_p.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-3, atol=1e-5)

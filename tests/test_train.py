@@ -81,6 +81,29 @@ def test_checkpoint_roundtrip(fitted, tmp_path):
     assert int(restored.step) == int(state.step)
 
 
+def test_legacy_checkpoint_without_rng_restores(fitted, tmp_path):
+    """Checkpoints written before TrainState grew the rng field must
+    still restore (crash-resume compatibility): the missing leaf takes
+    the freshly-initialized key."""
+    from lfm_quant_tpu.train import CheckpointManager
+    from lfm_quant_tpu.train.loop import restore_state_dict
+    import jax
+
+    _, _, trainer, _ = fitted
+    state = trainer.state
+    legacy = {k: v for k, v in state._asdict().items() if k != "rng"}
+    mgr = CheckpointManager(str(tmp_path / "legacy_ck"))
+    mgr.save(3, legacy, wait=True)
+    restored = restore_state_dict(mgr, state._asdict())
+    mgr.close()
+    assert set(restored) == set(state._asdict())
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(restored["rng"]),
+                                  np.asarray(state.rng))
+
+
 def test_predict_covers_eligible_test_anchors(fitted):
     _, _, trainer, splits = fitted
     fc, fc_valid = trainer.predict("test")
