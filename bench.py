@@ -73,6 +73,34 @@ def _emit(metric: str, value: float, mfu_pct: float, **extras) -> None:
     print(json.dumps(rec), flush=True)
 
 
+def measure_trainer(trainer, k: int = 30, reps: int = 3) -> float:
+    """Measured training throughput (firm-months/sec) of a built Trainer:
+    k steps of one epoch scanned inside a single jit dispatch — per-
+    dispatch tunnel latency is excluded by design, and the final float()
+    readback forces a true device sync (on the tunneled axon device,
+    block_until_ready alone does not wait)."""
+    import dataclasses as _dc
+
+    state = trainer.init_state()
+    b = trainer.train_sampler.stacked_epoch(0)
+    k = min(k, b.firm_idx.shape[0])
+    b = _dc.replace(b, firm_idx=b.firm_idx[:k], time_idx=b.time_idx[:k],
+                    weight=b.weight[:k])
+    fi, ti, w = trainer._batch_args(b, train=True, steps=True)
+    fm = float(b.weight.sum()) * trainer.window
+
+    _, ms = trainer._jit_multi_step(state, trainer.dev, fi, ti, w)
+    _ = float(ms["loss"][-1])  # warmup: compile + one full pass
+
+    t0 = time.perf_counter()
+    st = state
+    for _ in range(reps):
+        st, ms = trainer._jit_multi_step(st, trainer.dev, fi, ti, w)
+    _ = float(ms["loss"][-1])
+    dt = (time.perf_counter() - t0) / reps
+    return fm / dt
+
+
 def bench_c2() -> None:
     from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.data import PanelSplits, synthetic_panel
@@ -88,32 +116,7 @@ def bench_c2() -> None:
     )
     splits = PanelSplits.by_date(panel, 198601, 198801)
     trainer = Trainer(cfg, splits)
-    state = trainer.init_state()
-
-    # One epoch of index batches, scanned inside a single jit dispatch
-    # (lax.scan over steps) — per-dispatch latency is excluded by design,
-    # and the final float() readback forces a true device sync (on the
-    # tunneled axon device, block_until_ready alone does not wait).
-    b = trainer.train_sampler.stacked_epoch(0)
-    k = min(30, b.firm_idx.shape[0])
-    import dataclasses as _dc
-    b = _dc.replace(b, firm_idx=b.firm_idx[:k], time_idx=b.time_idx[:k],
-                    weight=b.weight[:k])
-    fi, ti, w = trainer._batch_args(b, train=True, steps=True)
-    fm = float(b.weight.sum()) * trainer.window
-
-    _, ms = trainer._jit_multi_step(state, trainer.dev, fi, ti, w)
-    _ = float(ms["loss"][-1])  # warmup: compile + one full pass
-
-    reps = 3
-    t0 = time.perf_counter()
-    st = state
-    for _ in range(reps):
-        st, ms = trainer._jit_multi_step(st, trainer.dev, fi, ti, w)
-    _ = float(ms["loss"][-1])
-    dt = (time.perf_counter() - t0) / reps
-
-    value = fm / dt
+    value = measure_trainer(trainer)
     flops = _lstm_train_flops_per_fm(
         cfg.model.kwargs.get("hidden", 128), d.n_features)
     _emit("train_throughput_c2_lstm", value,
