@@ -101,6 +101,31 @@ def measure_trainer(trainer, k: int = 30, reps: int = 3) -> float:
     return fm / dt
 
 
+def measure_ensemble_trainer(trainer, k: int = 10, reps: int = 3) -> float:
+    """measure_trainer's twin for an EnsembleTrainer: k vmapped steps of
+    the [K, S, D, Bf] stacked epoch per dispatch, all seeds counted in the
+    firm-month total, device sync via scalar readback (see
+    measure_trainer's docstring for why)."""
+    import numpy as np
+
+    state = trainer.init_state()
+    fi, ti, w = trainer._stacked_epoch(0)
+    k = min(k, fi.shape[0])
+    fi, ti, w = fi[:k], ti[:k], w[:k]
+    fm = float(np.asarray(w).sum()) * trainer.window  # all seeds
+
+    _, ms = trainer._jit_multi_step(state, trainer.dev, fi, ti, w)
+    _ = float(np.asarray(ms["loss"])[-1].mean())  # warmup
+
+    t0 = time.perf_counter()
+    st = state
+    for _ in range(reps):
+        st, ms = trainer._jit_multi_step(st, trainer.dev, fi, ti, w)
+    _ = float(np.asarray(ms["loss"])[-1].mean())
+    dt = (time.perf_counter() - t0) / reps
+    return fm / dt
+
+
 def bench_c2() -> None:
     from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.data import PanelSplits, synthetic_panel
@@ -126,8 +151,6 @@ def bench_c2() -> None:
 def bench_c5_ensemble() -> None:
     import dataclasses as _dc
 
-    import numpy as np
-
     from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.data import PanelSplits, synthetic_panel
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
@@ -145,25 +168,9 @@ def bench_c5_ensemble() -> None:
     )
     splits = PanelSplits.by_date(panel, 198601, 198801)
     trainer = EnsembleTrainer(cfg, splits)
-    state = trainer.init_state()
-
-    k = int(os.environ.get("LFM_BENCH_STEPS", "10"))
-    fi, ti, w = trainer._stacked_epoch(0)
-    fi, ti, w = fi[:k], ti[:k], w[:k]
-    fm = float(np.asarray(w).sum()) * trainer.window  # all seeds
-
-    _, ms = trainer._jit_multi_step(state, trainer.dev, fi, ti, w)
-    _ = float(np.asarray(ms["loss"])[-1].mean())  # warmup
-
-    reps = 3
-    t0 = time.perf_counter()
-    st = state
-    for _ in range(reps):
-        st, ms = trainer._jit_multi_step(st, trainer.dev, fi, ti, w)
-    _ = float(np.asarray(ms["loss"])[-1].mean())
-    dt = (time.perf_counter() - t0) / reps
-
-    value = fm / dt  # one chip hosts the whole seed stack
+    value = measure_ensemble_trainer(
+        trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10")))
+    # value counts all seeds; one chip hosts the whole seed stack.
     flops = _lstm_train_flops_per_fm(
         cfg.model.kwargs.get("hidden", 128), d.n_features)
     _emit("train_throughput_c5_ensemble", value,

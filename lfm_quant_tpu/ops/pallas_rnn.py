@@ -14,15 +14,27 @@ the whole scan into ONE Pallas call so that:
 * all elementwise gate math fuses with the ``[Bb, H] @ [H, G·H]`` recurrent
   matmul in a single kernel instead of separate XLA fusions per scan step.
 
-Layout: internally time-major ``[T, B, ·]`` so every grid block has MXU/VPU
-friendly trailing dims ``(Bb, G·H)``; the public wrapper takes/returns the
-batch-major ``[B, T, ·]`` layout the models use.
+Layout: internally time-major ``[S, T, B, ·]`` — a leading SEED axis (the
+ensemble's vmap axis, grid-mapped so each member's recurrent weights stay
+VMEM-resident for its whole batch×time sweep) then time-major so every grid
+block has MXU/VPU friendly trailing dims ``(Bb, G·H)``. The public wrapper
+takes/returns the batch-major ``[B, T, ·]`` layout the models use; S = 1 for
+the single-model path (a size-1 grid dim costs nothing).
+
+``jax.vmap`` support is NATIVE: the forward/backward pallas_calls sit behind
+``jax.custom_batching.custom_vmap`` whose rule dispatches the stacked inputs
+onto the seed grid axis. JAX's generic pallas batching rule would instead
+insert a squeezed block at the operand's batch dim — which lands mid-array
+for the recurrent weights and violates the TPU "last two block dims" layout
+constraint (a lowering error interpret-mode CI cannot see). One vmap level
+is supported — exactly the ensemble's seed axis; don't nest vmaps over this
+op.
 
 Training support is a full ``jax.custom_vjp``: the backward kernel walks the
 grid in reverse time order, **recomputes the gates** from the saved per-step
 states (one extra recurrent matmul instead of materializing 4·H activations
-per step), and accumulates ``dW_h`` into a VMEM-resident f32 block that is
-written back once at the end.
+per step), and accumulates ``dW_h`` into a VMEM-resident f32 block (one per
+seed) that is written back once at the end.
 
 Masking semantics match models/rnn.py exactly: an invalid month HOLDS the
 carried state, so left-padded short histories keep the initial zero state
@@ -30,9 +42,8 @@ until the first valid month.
 
 Multi-device caveat: a ``pallas_call`` is opaque to GSPMD — under a
 data-parallel mesh it must sit inside ``shard_map`` (each shard runs its own
-kernel on its local batch). Single-device jit (the bench path and all
-single-chip configs) needs no wrapping. ``RNNModel(scan_impl="pallas")``
-(models/rnn.py) is therefore opt-in; the XLA scan remains the default.
+kernel on its local batch), which is exactly how the trainers run it
+(train/loop.py, train/ensemble.py).
 """
 
 from __future__ import annotations
@@ -42,6 +53,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.custom_batching import custom_vmap
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -114,14 +126,15 @@ def rnn_scan_reference(cell: str, xw: jax.Array, wh: jax.Array, m: jax.Array,
 
 
 # ---------------------------------------------------------------------------
-# Forward kernels. Grid = (B blocks, T); t is the fast axis, so for each
-# batch block the pipeline sweeps t = 0..T-1 while h/c persist in scratch.
+# Forward kernels. Grid = (S, B blocks, T); t is the fast axis, so for each
+# (seed, batch block) the pipeline sweeps t = 0..T-1 while h/c persist in
+# scratch and the seed's recurrent weights stay resident in VMEM.
 # ---------------------------------------------------------------------------
 
 
 def _lstm_fwd_kernel(xw_ref, wh_ref, m_ref, h_out, c_out, h_s, c_s, *,
                      forget_bias: float):
-    t = pl.program_id(1)
+    t = pl.program_id(2)
 
     @pl.when(t == 0)
     def _():
@@ -129,73 +142,73 @@ def _lstm_fwd_kernel(xw_ref, wh_ref, m_ref, h_out, c_out, h_s, c_s, *,
         c_s[...] = jnp.zeros_like(c_s)
 
     h, c = h_s[...], c_s[...]
-    gates = xw_ref[0].astype(jnp.float32) + jnp.dot(
-        h.astype(wh_ref.dtype), wh_ref[...], preferred_element_type=jnp.float32)
+    gates = xw_ref[0, 0].astype(jnp.float32) + jnp.dot(
+        h.astype(wh_ref.dtype), wh_ref[0], preferred_element_type=jnp.float32)
     i, f, g, o = _lstm_gates(gates, forget_bias)
     c_new = f * c + i * g
     h_new = o * jnp.tanh(c_new)
-    keep = m_ref[0].astype(jnp.float32)
+    keep = m_ref[0, 0].astype(jnp.float32)
     h = keep * h_new + (1.0 - keep) * h
     c = keep * c_new + (1.0 - keep) * c
     h_s[...], c_s[...] = h, c
-    h_out[0] = h.astype(h_out.dtype)
-    c_out[0] = c.astype(c_out.dtype)
+    h_out[0, 0] = h.astype(h_out.dtype)
+    c_out[0, 0] = c.astype(c_out.dtype)
 
 
 def _gru_fwd_kernel(xw_ref, wh_ref, m_ref, h_out, h_s):
-    t = pl.program_id(1)
+    t = pl.program_id(2)
 
     @pl.when(t == 0)
     def _():
         h_s[...] = jnp.zeros_like(h_s)
 
     h = h_s[...]
-    hw = jnp.dot(h.astype(wh_ref.dtype), wh_ref[...],
+    hw = jnp.dot(h.astype(wh_ref.dtype), wh_ref[0],
                  preferred_element_type=jnp.float32)
-    z, r, n, _ = _gru_parts(xw_ref[0].astype(jnp.float32), hw)
+    z, r, n, _ = _gru_parts(xw_ref[0, 0].astype(jnp.float32), hw)
     h_new = (1.0 - z) * n + z * h
-    keep = m_ref[0].astype(jnp.float32)
+    keep = m_ref[0, 0].astype(jnp.float32)
     h = keep * h_new + (1.0 - keep) * h
     h_s[...] = h
-    h_out[0] = h.astype(h_out.dtype)
+    h_out[0, 0] = h.astype(h_out.dtype)
 
 
 # ---------------------------------------------------------------------------
-# Backward kernels. Grid = (B blocks, T) with time index maps REVERSED
+# Backward kernels. Grid = (S, B blocks, T) with time index maps REVERSED
 # (grid step t touches real time tr = T-1-t). Gates are recomputed from the
-# saved previous state; dW_h accumulates into a constant-index-map output
-# block that stays VMEM-resident for the whole kernel.
+# saved previous state; dW_h accumulates into a per-seed constant-index-map
+# output block that stays VMEM-resident for that seed's whole sweep.
 # ---------------------------------------------------------------------------
 
 
 def _lstm_bwd_kernel(xw_ref, wh_ref, m_ref, hp_ref, cp_ref, cc_ref, dh_ref,
                      dxw_ref, dwh_ref, dh_s, dc_s, *, forget_bias: float):
-    t = pl.program_id(1)
-    T = pl.num_programs(1)
+    t = pl.program_id(2)
+    T = pl.num_programs(2)
 
     @pl.when(t == 0)
     def _():
         dh_s[...] = jnp.zeros_like(dh_s)
         dc_s[...] = jnp.zeros_like(dc_s)
 
-    @pl.when((pl.program_id(0) == 0) & (t == 0))
+    @pl.when((pl.program_id(1) == 0) & (t == 0))
     def _():
         dwh_ref[...] = jnp.zeros_like(dwh_ref)
 
     # tr == 0 (grid t == T-1): the previous state is the zero initial state;
     # the clamped index map re-reads step 0, so override with zeros.
     first = t == T - 1
-    h_prev = jnp.where(first, 0.0, hp_ref[0].astype(jnp.float32))
-    c_prev = jnp.where(first, 0.0, cp_ref[0].astype(jnp.float32))
-    c_cur = cc_ref[0].astype(jnp.float32)  # masked c_t; safe, see below
-    keep = m_ref[0].astype(jnp.float32)
+    h_prev = jnp.where(first, 0.0, hp_ref[0, 0].astype(jnp.float32))
+    c_prev = jnp.where(first, 0.0, cp_ref[0, 0].astype(jnp.float32))
+    c_cur = cc_ref[0, 0].astype(jnp.float32)  # masked c_t; safe, see below
+    keep = m_ref[0, 0].astype(jnp.float32)
 
-    gates = xw_ref[0].astype(jnp.float32) + jnp.dot(
-        h_prev.astype(wh_ref.dtype), wh_ref[...],
+    gates = xw_ref[0, 0].astype(jnp.float32) + jnp.dot(
+        h_prev.astype(wh_ref.dtype), wh_ref[0],
         preferred_element_type=jnp.float32)
     i, f, g, o = _lstm_gates(gates, forget_bias)
 
-    dh_t = dh_ref[0].astype(jnp.float32) + dh_s[...]
+    dh_t = dh_ref[0, 0].astype(jnp.float32) + dh_s[...]
     dc_t = dc_s[...]
     # Mask blend: h_t = keep·h_new + (1-keep)·h_prev (same for c). Every
     # gate-path grad below carries a ``keep`` factor, so substituting the
@@ -215,40 +228,40 @@ def _lstm_bwd_kernel(xw_ref, wh_ref, m_ref, hp_ref, cp_ref, cc_ref, dh_ref,
         dg * (1.0 - g * g),
         do * o * (1.0 - o),
     ], axis=-1)
-    dxw_ref[0] = d_gates.astype(dxw_ref.dtype)
+    dxw_ref[0, 0] = d_gates.astype(dxw_ref.dtype)
     # dh_prev: direct (masked-out) path + through the recurrent matmul.
     dh_s[...] = (1.0 - keep) * dh_t + jax.lax.dot_general(
-        d_gates, wh_ref[...].astype(jnp.float32),
+        d_gates, wh_ref[0].astype(jnp.float32),
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     dc_s[...] = (1.0 - keep) * dc_t + dc_tot * f
-    dwh_ref[...] += jax.lax.dot_general(
+    dwh_ref[0] += jax.lax.dot_general(
         h_prev, d_gates, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
 def _gru_bwd_kernel(xw_ref, wh_ref, m_ref, hp_ref, dh_ref,
                     dxw_ref, dwh_ref, dh_s):
-    t = pl.program_id(1)
-    T = pl.num_programs(1)
+    t = pl.program_id(2)
+    T = pl.num_programs(2)
 
     @pl.when(t == 0)
     def _():
         dh_s[...] = jnp.zeros_like(dh_s)
 
-    @pl.when((pl.program_id(0) == 0) & (t == 0))
+    @pl.when((pl.program_id(1) == 0) & (t == 0))
     def _():
         dwh_ref[...] = jnp.zeros_like(dwh_ref)
 
     first = t == T - 1
-    h_prev = jnp.where(first, 0.0, hp_ref[0].astype(jnp.float32))
-    keep = m_ref[0].astype(jnp.float32)
+    h_prev = jnp.where(first, 0.0, hp_ref[0, 0].astype(jnp.float32))
+    keep = m_ref[0, 0].astype(jnp.float32)
 
-    hw = jnp.dot(h_prev.astype(wh_ref.dtype), wh_ref[...],
+    hw = jnp.dot(h_prev.astype(wh_ref.dtype), wh_ref[0],
                  preferred_element_type=jnp.float32)
-    z, r, n, hn = _gru_parts(xw_ref[0].astype(jnp.float32), hw)
+    z, r, n, hn = _gru_parts(xw_ref[0, 0].astype(jnp.float32), hw)
 
-    dh_t = dh_ref[0].astype(jnp.float32) + dh_s[...]
+    dh_t = dh_ref[0, 0].astype(jnp.float32) + dh_s[...]
     dh_new = keep * dh_t
     dz = dh_new * (h_prev - n)
     dn_raw = dh_new * (1.0 - z) * (1.0 - n * n)
@@ -259,19 +272,20 @@ def _gru_bwd_kernel(xw_ref, wh_ref, m_ref, hp_ref, dh_ref,
     d_hw = jnp.concatenate([d_hz, d_hr, d_hn], axis=-1)
     # x-side pre-activations share the z/r grads; the candidate's x side
     # skips the reset gate (reset-after-projection variant).
-    dxw_ref[0] = jnp.concatenate(
+    dxw_ref[0, 0] = jnp.concatenate(
         [d_hz, d_hr, dn_raw], axis=-1).astype(dxw_ref.dtype)
     dh_s[...] = (1.0 - keep) * dh_t + dh_new * z + jax.lax.dot_general(
-        d_hw, wh_ref[...].astype(jnp.float32),
+        d_hw, wh_ref[0].astype(jnp.float32),
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
-    dwh_ref[...] += jax.lax.dot_general(
+    dwh_ref[0] += jax.lax.dot_general(
         h_prev, d_hw, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
 # ---------------------------------------------------------------------------
-# pallas_call plumbing + custom VJP.
+# pallas_call plumbing: all calls are seed-batched ([S, T, Bp, ·]); S = 1
+# for the unbatched public op.
 # ---------------------------------------------------------------------------
 
 
@@ -285,25 +299,60 @@ def _blocks(B: int, block_b: Optional[int]) -> Tuple[int, int]:
     return _round_up(B, bb), bb
 
 
-def _fwd_call(cell: str, xw_t, wh, m_t, forget_bias, bb, interpret):
-    """Run the forward kernel on time-major inputs; returns per-step states.
+def _seed_extent(name: str, *arrays) -> int:
+    """Common seed extent of leading axes that are each S or 1 (size-1 =
+    shared across seeds — read via a pinned index map, never materialized
+    S times in HBM)."""
+    S = 1
+    for a in arrays:
+        s = a.shape[0]
+        if s != 1 and s != S:
+            if S != 1:
+                raise ValueError(
+                    f"{name}: seed extents disagree ({s} vs {S})")
+            S = s
+    return S
 
-    xw_t: [T, Bp, G·H]; m_t: [T, Bp]; returns h_all [T, Bp, H] (+ c_all for
-    LSTM) in xw's dtype.
+
+def _ensure_seed(outs, axis_size: int):
+    """Broadcast kernel outputs up to the vmap axis size — only the
+    degenerate all-operands-shared vmap produces S=1 outputs here."""
+    if outs[0].shape[0] != axis_size:
+        outs = tuple(jnp.broadcast_to(o, (axis_size,) + o.shape[1:])
+                     for o in outs)
+    return tuple(outs)
+
+
+def _sidx(extent: int):
+    """Seed coordinate for an operand's index map: the real grid coordinate
+    when the operand is seed-stacked, pinned 0 when shared (size-1)."""
+    return (lambda s: s) if extent > 1 else (lambda s: 0)
+
+
+def _fwd_call(cell: str, xw_t, wh, m_t, forget_bias, bb, interpret):
+    """Run the forward kernel on seed-stacked time-major inputs.
+
+    xw_t: [S|1, T, Bp, G·H]; wh: [S|1, H, G·H]; m_t: [S|1, T, Bp, 1] —
+    size-1 leading axes are shared across seeds. Returns h_all
+    [S, T, Bp, H] (+ c_all for LSTM) in xw's dtype.
     """
-    T, Bp, G = xw_t.shape
+    _, T, Bp, G = xw_t.shape
+    S = _seed_extent("rnn_scan", xw_t, wh, m_t)
     H = G // _GATES[cell]
-    grid = (Bp // bb, T)
+    grid = (S, Bp // bb, T)
     vmem = pltpu.VMEM
+    sx, sw, sm = _sidx(xw_t.shape[0]), _sidx(wh.shape[0]), _sidx(m_t.shape[0])
     in_specs = [
-        pl.BlockSpec((1, bb, G), lambda i, t: (t, i, 0), memory_space=vmem),
-        pl.BlockSpec((H, G), lambda i, t: (0, 0), memory_space=vmem),
-        pl.BlockSpec((1, bb, 1), lambda i, t: (t, i, 0),
+        pl.BlockSpec((1, 1, bb, G), lambda s, i, t: (sx(s), t, i, 0),
+                     memory_space=vmem),
+        pl.BlockSpec((1, H, G), lambda s, i, t: (sw(s), 0, 0),
+                     memory_space=vmem),
+        pl.BlockSpec((1, 1, bb, 1), lambda s, i, t: (sm(s), t, i, 0),
                      memory_space=vmem),
     ]
-    state_spec = pl.BlockSpec((1, bb, H), lambda i, t: (t, i, 0),
+    state_spec = pl.BlockSpec((1, 1, bb, H), lambda s, i, t: (s, t, i, 0),
                               memory_space=vmem)
-    state_shape = jax.ShapeDtypeStruct((T, Bp, H), xw_t.dtype)
+    state_shape = jax.ShapeDtypeStruct((S, T, Bp, H), xw_t.dtype)
     scratch = pltpu.VMEM((bb, H), jnp.float32)
     if cell == "lstm":
         return pl.pallas_call(
@@ -314,111 +363,193 @@ def _fwd_call(cell: str, xw_t, wh, m_t, forget_bias, bb, interpret):
             scratch_shapes=[scratch, scratch],
             interpret=interpret,
         )(xw_t, wh, m_t)
-    return pl.pallas_call(
+    return (pl.pallas_call(
         _gru_fwd_kernel,
         grid=grid, in_specs=in_specs,
         out_specs=state_spec, out_shape=state_shape,
         scratch_shapes=[scratch],
         interpret=interpret,
-    )(xw_t, wh, m_t)
+    )(xw_t, wh, m_t),)
 
 
 def _bwd_call(cell: str, xw_t, wh, m_t, saved, dh_t, forget_bias, bb,
               interpret):
-    """Reverse-time backward kernel → (dxw_t [T,Bp,G], dwh f32 [H,G])."""
-    T, Bp, G = xw_t.shape
+    """Reverse-time backward kernel → (dxw_t [S,T,Bp,G], dwh f32 [S,H,G]).
+
+    Size-1 leading axes mark seed-shared operands, as in :func:`_fwd_call`.
+    """
+    _, T, Bp, G = xw_t.shape
+    S = _seed_extent("rnn_scan bwd", xw_t, wh, m_t, *saved, dh_t)
     H = G // _GATES[cell]
-    grid = (Bp // bb, T)
+    grid = (S, Bp // bb, T)
 
-    def rev(i, t):
-        return (T - 1 - t, i, 0)
+    def rev(sx):
+        return lambda s, i, t: (sx(s), T - 1 - t, i, 0)
 
-    def rev_prev(i, t):
-        return (jnp.maximum(T - 2 - t, 0), i, 0)
+    def rev_prev(sx):
+        return lambda s, i, t: (sx(s), jnp.maximum(T - 2 - t, 0), i, 0)
 
     vmem = pltpu.VMEM
-    state_spec = pl.BlockSpec((1, bb, H), rev, memory_space=vmem)
-    prev_spec = pl.BlockSpec((1, bb, H), rev_prev, memory_space=vmem)
+
+    def state_spec(n):
+        return pl.BlockSpec((1, 1, bb, H), rev(_sidx(n)), memory_space=vmem)
+
+    def prev_spec(n):
+        return pl.BlockSpec((1, 1, bb, H), rev_prev(_sidx(n)),
+                            memory_space=vmem)
+
+    sw = _sidx(wh.shape[0])
+    wh_spec = pl.BlockSpec((1, H, G), lambda s, i, t: (sw(s), 0, 0),
+                           memory_space=vmem)
     in_specs = [
-        pl.BlockSpec((1, bb, G), rev, memory_space=vmem),
-        pl.BlockSpec((H, G), lambda i, t: (0, 0), memory_space=vmem),
-        pl.BlockSpec((1, bb, 1), lambda i, t: (T - 1 - t, i, 0),
+        pl.BlockSpec((1, 1, bb, G), rev(_sidx(xw_t.shape[0])),
+                     memory_space=vmem),
+        wh_spec,
+        pl.BlockSpec((1, 1, bb, 1), rev(_sidx(m_t.shape[0])),
                      memory_space=vmem),
     ]
     if cell == "lstm":
         h_all, c_all = saved
-        in_specs += [prev_spec, prev_spec, state_spec]
+        in_specs += [prev_spec(h_all.shape[0]), prev_spec(c_all.shape[0]),
+                     state_spec(c_all.shape[0])]
         inputs = (xw_t, wh, m_t, h_all, c_all, c_all, dh_t)
         kernel = functools.partial(_lstm_bwd_kernel, forget_bias=forget_bias)
         n_scratch = 2
     else:
         (h_all,) = saved
-        in_specs += [prev_spec]
+        in_specs += [prev_spec(h_all.shape[0])]
         inputs = (xw_t, wh, m_t, h_all, dh_t)
         kernel = _gru_bwd_kernel
         n_scratch = 1
-    in_specs.append(state_spec)  # dh upstream
+    in_specs.append(state_spec(dh_t.shape[0]))  # dh upstream
     dxw_t, dwh = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
-        out_specs=(pl.BlockSpec((1, bb, G), rev, memory_space=vmem),
-                   pl.BlockSpec((H, G), lambda i, t: (0, 0),
+        out_specs=(pl.BlockSpec((1, 1, bb, G), rev(lambda s: s),
+                                memory_space=vmem),
+                   pl.BlockSpec((1, H, G), lambda s, i, t: (s, 0, 0),
                                 memory_space=vmem)),
-        out_shape=(jax.ShapeDtypeStruct((T, Bp, G), xw_t.dtype),
-                   jax.ShapeDtypeStruct((H, G), jnp.float32)),
+        out_shape=(jax.ShapeDtypeStruct((S, T, Bp, G), xw_t.dtype),
+                   jax.ShapeDtypeStruct((S, H, G), jnp.float32)),
         scratch_shapes=[pltpu.VMEM((bb, H), jnp.float32)] * n_scratch,
         interpret=interpret,
     )(*inputs)
     return dxw_t, dwh
 
 
+def _seed_axis(batched: bool, x: jax.Array) -> jax.Array:
+    """custom_vmap rule operand → leading seed axis: batched args arrive
+    with the vmap axis at the front; shared args get a SIZE-1 axis — the
+    kernels read them in place via pinned index maps, never S HBM copies."""
+    return x if batched else x[None]
+
+
 @functools.lru_cache(maxsize=None)
 def _make_scan(cell: str, forget_bias: float, block_b: Optional[int],
                interpret: bool):
-    """Build the custom-VJP fused scan for one static configuration."""
+    """Build the custom-VJP fused scan for one static configuration.
+
+    Structure: ``scan`` is a ``jax.custom_vjp`` whose fwd/bwd run the Pallas
+    kernels through ``custom_vmap``-wrapped ops — an unbatched call runs the
+    kernel with a size-1 seed grid axis; a vmapped call (the ensemble's seed
+    axis) dispatches the stacked operands onto the real seed grid axis. This
+    composition (custom_vjp outermost) is the one that supports
+    ``vmap(grad(...))``; the reverse nesting breaks reverse-mode AD.
+    """
 
     def to_time_major(xw, m, bb_pad):
-        xw_t = jnp.swapaxes(xw, 0, 1)
-        m_t = jnp.swapaxes(m, 0, 1)[..., None]
+        # [.., B, T, G] batch-major → [.., T, Bp, G] time-major padded.
+        xw_t = jnp.swapaxes(xw, -3, -2)
+        m_t = jnp.swapaxes(m, -2, -1)[..., None]
         if bb_pad:
-            xw_t = jnp.pad(xw_t, ((0, 0), (0, bb_pad), (0, 0)))
-            m_t = jnp.pad(m_t, ((0, 0), (0, bb_pad), (0, 0)))
+            pad = [(0, 0)] * (xw_t.ndim - 2) + [(0, bb_pad), (0, 0)]
+            xw_t = jnp.pad(xw_t, pad)
+            m_t = jnp.pad(m_t, pad)
         return xw_t, m_t
 
-    @jax.custom_vjp
-    def scan(xw, wh, m):
-        B = xw.shape[0]
+    # ---- forward op: [S|1, B, T, G] stacked impl shared by the
+    # unbatched (S = 1) and vmapped (seed-axis) paths. Besides the kernel
+    # outputs it returns the time-major padded xw_t/m_t views so the
+    # backward pass reuses them as residuals instead of re-transposing
+    # the largest activation every step.
+
+    def fwd_stacked(xw, wh, m):
+        B = xw.shape[-3]
         Bp, bb = _blocks(B, block_b)
         xw_t, m_t = to_time_major(xw, m, Bp - B)
-        out = _fwd_call(cell, xw_t, wh, m_t, forget_bias, bb, interpret)
-        h_all = out[0] if cell == "lstm" else out
-        return jnp.swapaxes(h_all, 0, 1)[:B]
+        return (xw_t, m_t) + _fwd_call(cell, xw_t, wh, m_t, forget_bias,
+                                       bb, interpret)
 
-    def fwd(xw, wh, m):
-        B = xw.shape[0]
-        Bp, bb = _blocks(B, block_b)
-        xw_t, m_t = to_time_major(xw, m, Bp - B)
-        out = _fwd_call(cell, xw_t, wh, m_t, forget_bias, bb, interpret)
-        saved = out if cell == "lstm" else (out,)
-        h_all = saved[0]
-        return (jnp.swapaxes(h_all, 0, 1)[:B],
-                (xw_t, wh, m_t, saved, B))
+    @custom_vmap
+    def fwd_op(xw, wh, m):
+        out = fwd_stacked(xw[None], wh[None], m[None])
+        return tuple(s[0] for s in out)  # drop the size-1 seed axis
 
-    def bwd(res, dh):
-        xw_t, wh, m_t, saved, B = res
-        Bp = xw_t.shape[1]
-        _, bb = _blocks(Bp, block_b)
-        dh_t = jnp.swapaxes(dh, 0, 1)
+    @fwd_op.def_vmap
+    def _fwd_vmap(axis_size, in_batched, xw, wh, m):
+        xw_t, m_t, *kout = fwd_stacked(_seed_axis(in_batched[0], xw),
+                                       _seed_axis(in_batched[1], wh),
+                                       _seed_axis(in_batched[2], m))
+        kout = _ensure_seed(kout, axis_size)
+        # xw_t/m_t stay unbatched when their sources are shared — keeping
+        # a shared residual SHARED avoids S HBM copies on the eval path.
+        xw_t = xw_t if in_batched[0] else xw_t[0]
+        m_t = m_t if in_batched[2] else m_t[0]
+        return ((xw_t, m_t, *kout),
+                (in_batched[0], in_batched[2]) + (True,) * len(kout))
+
+    # ---- backward op: reverse-time kernel over the kernel-layout
+    # residuals — xw_t/m_t [T, Bp, ·] from fwd_op and the saved per-step
+    # states [T, Bp, H] (each stacked [S, ...] under vmap). Only the
+    # upstream dh arrives batch-major.
+
+    def bwd_stacked(xw_t, wh, m_t, saved, dh):
+        Bp = xw_t.shape[-2]
+        B = dh.shape[-3]
+        _, bb = _blocks(B, block_b)
+        dh_t = jnp.swapaxes(dh, -3, -2)
         if Bp != B:
-            dh_t = jnp.pad(dh_t, ((0, 0), (0, Bp - B), (0, 0)))
+            pad = [(0, 0)] * (dh_t.ndim - 2) + [(0, Bp - B), (0, 0)]
+            dh_t = jnp.pad(dh_t, pad)
         dxw_t, dwh = _bwd_call(cell, xw_t, wh, m_t, saved,
                                dh_t.astype(xw_t.dtype), forget_bias, bb,
                                interpret)
-        dxw = jnp.swapaxes(dxw_t, 0, 1)[:B]
+        return jnp.swapaxes(dxw_t, 1, 2)[:, :B], dwh
+
+    @custom_vmap
+    def bwd_op(xw_t, wh, m_t, saved, dh):
+        dxw, dwh = bwd_stacked(xw_t[None], wh[None], m_t[None],
+                               tuple(s[None] for s in saved), dh[None])
+        return dxw[0], dwh[0]
+
+    @bwd_op.def_vmap
+    def _bwd_vmap(axis_size, in_batched, xw_t, wh, m_t, saved, dh):
+        out = bwd_stacked(_seed_axis(in_batched[0], xw_t),
+                          _seed_axis(in_batched[1], wh),
+                          _seed_axis(in_batched[2], m_t),
+                          tuple(_seed_axis(b, s)
+                                for b, s in zip(in_batched[3], saved)),
+                          _seed_axis(in_batched[4], dh))
+        return _ensure_seed(out, axis_size), (True, True)
+
+    # ---- public custom-VJP op ----------------------------------------
+
+    @jax.custom_vjp
+    def scan(xw, wh, m):
+        out = fwd_op(xw, wh, m)
+        return jnp.swapaxes(out[2], 0, 1)[:xw.shape[0]]
+
+    def fwd(xw, wh, m):
+        out = fwd_op(xw, wh, m)
+        h = jnp.swapaxes(out[2], 0, 1)[:xw.shape[0]]
+        return h, (out[0], wh, out[1], out[2:])
+
+    def bwd(res, dh):
+        xw_t, wh, m_t, saved = res
+        dxw, dwh = bwd_op(xw_t, wh, m_t, saved, dh)
         # The mask is data, never a trained quantity — no gradient.
-        dm = jnp.zeros((B, xw_t.shape[0]), wh.dtype)
-        return dxw, dwh.astype(wh.dtype), dm
+        return dxw, dwh.astype(wh.dtype), jnp.zeros(dh.shape[:-1], dh.dtype)
 
     scan.defvjp(fwd, bwd)
     return scan
@@ -440,6 +571,9 @@ def rnn_scan(cell: str, xw: jax.Array, wh: jax.Array, m: jax.Array, *,
         up to 8)); B is padded to a multiple of it.
       interpret: force Pallas interpret mode; default auto — True off-TPU so
         the same code runs in CPU CI (SURVEY.md §5's simulated-mesh testing).
+
+    ``jax.vmap`` over any combination of the three operands maps onto the
+    kernels' native seed grid axis (ONE vmap level — the ensemble's).
 
     Returns:
       ``[B, T, H]`` per-step hidden states in ``xw.dtype``.

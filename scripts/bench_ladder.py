@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Measure training throughput for EVERY capability-ladder config (c1–c5)
+at its own geometry on the current backend (the single real chip under
+axon; CPU when forced) — the evidence stream for BASELINE.md's measured
+table (SURVEY.md §7: "every config on the ladder gets a recorded number").
+
+Each line: {"metric": "train_throughput_<cfg>", "value": fm/s, "unit":
+"firm-months/sec/chip", "mfu_pct": ...} — same schema as bench.py (which
+stays the driver-facing 2-metric harness; this script is the full sweep).
+
+Multi-shard configs (c3: 8-way date sharding, c4: 16-way) degrade to the
+single visible device — the measured number exercises the full batch
+geometry, the rank-IC loss (c3) and bf16 transformer (c4) paths; the mesh
+variants of the same step are equality-tested on the virtual 8-device CPU
+mesh (tests/test_parallel.py), so per-shard throughput transfers.
+
+Run: python scripts/bench_ladder.py [c1 c2 ...]   (default: all)
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import (  # noqa: E402
+    V5E_BF16_PEAK,
+    measure_ensemble_trainer,
+    measure_trainer,
+)
+
+
+def _mlp_train_flops_per_fm(hidden, window: int, features: int) -> float:
+    """MLP consumes the flattened [W·F] window per anchor; amortize the
+    per-window FLOPs over its W firm-months to keep the metric comparable
+    across model families."""
+    dims = (window * features,) + tuple(hidden) + (1,)
+    per_window = sum(2 * a * b for a, b in zip(dims, dims[1:]))
+    return 3.0 * per_window / window
+
+
+def _rnn_train_flops_per_fm(cell: str, hidden: int, features: int) -> float:
+    gates = {"lstm": 4, "gru": 3}[cell]
+    fwd = 2 * features * hidden + 2 * hidden * gates * hidden * 2
+    return 3.0 * fwd
+
+
+def _transformer_train_flops_per_fm(dim: int, depth: int, window: int,
+                                    features: int) -> float:
+    """Per token (= firm-month): embed + depth × (qkvo projections,
+    attention scores/values over the W-token window, 4× MLP)."""
+    per_layer = 8 * dim * dim + 4 * window * dim + 16 * dim * dim
+    fwd = 2 * features * dim + depth * per_layer
+    return 3.0 * fwd
+
+
+def _flops_per_fm(cfg) -> float:
+    kind, kw, d = cfg.model.kind, cfg.model.kwargs, cfg.data
+    if kind == "mlp":
+        return _mlp_train_flops_per_fm(kw.get("hidden", (64, 32)), d.window,
+                                       d.n_features)
+    if kind in ("lstm", "gru"):
+        return _rnn_train_flops_per_fm(kind, kw.get("hidden", 128),
+                                       d.n_features)
+    return _transformer_train_flops_per_fm(kw.get("dim", 64),
+                                           kw.get("depth", 2), d.window,
+                                           d.n_features)
+
+
+def _bench_panel(cfg):
+    """Full firm/feature/window geometry; months trimmed to 4× the window
+    so panel generation isn't the bottleneck (throughput is O(batch), not
+    O(panel), once the panel is HBM-resident)."""
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+
+    d = cfg.data
+    n_months = min(d.n_months, max(4 * d.window, 240))
+    panel = synthetic_panel(n_firms=d.n_firms, n_months=n_months,
+                            n_features=d.n_features, horizon=d.horizon,
+                            seed=0)
+    dates = panel.dates
+    train_end = int(dates[int(len(dates) * 0.80)])
+    val_end = int(dates[int(len(dates) * 0.90)])
+    return PanelSplits.by_date(panel, train_end, val_end)
+
+
+def bench_config(name: str) -> dict:
+    from lfm_quant_tpu.config import get_preset
+    from lfm_quant_tpu.train import Trainer
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+    cfg = get_preset(name)
+    splits = _bench_panel(cfg)
+    if cfg.n_seeds > 1:
+        n_seeds = int(os.environ.get("LFM_BENCH_SEEDS", "16"))
+        cfg = dataclasses.replace(cfg, n_seeds=n_seeds)
+        trainer = EnsembleTrainer(cfg, splits)
+        value = measure_ensemble_trainer(
+            trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10")))
+    else:
+        trainer = Trainer(cfg, splits)
+        value = measure_trainer(
+            trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "30")))
+    flops = _flops_per_fm(cfg)
+    return {
+        "metric": f"train_throughput_{name}",
+        "value": round(value, 1),
+        "unit": "firm-months/sec/chip",
+        "mfu_pct": round(100.0 * value * flops / V5E_BF16_PEAK, 2),
+        "config": cfg.name,
+        "loss": cfg.optim.loss,
+    }
+
+
+def main(argv) -> int:
+    names = argv or ["c1", "c2", "c3", "c4", "c5"]
+    for name in names:
+        rec = bench_config(name)
+        print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
