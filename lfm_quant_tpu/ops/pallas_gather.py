@@ -43,6 +43,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.custom_batching import custom_vmap
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -127,7 +128,7 @@ def _make_gather(window: int, n_months: int, bf: int, bb: int,
                  interpret: bool):
     w_pad, max_start8 = _aligned_span(window, n_months)
 
-    def call(xm, firm_idx, time_idx):
+    def call_flat(xm, firm_idx, time_idx):
         D = firm_idx.shape[0]
         Fp = xm.shape[-1]
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -148,6 +149,36 @@ def _make_gather(window: int, n_months: int, bf: int, bb: int,
             out_shape=jax.ShapeDtypeStruct((D, bf, w_pad, Fp), xm.dtype),
             interpret=interpret,
         )(firm_idx.reshape(-1), time_idx, xm)
+
+    # ``jax.vmap`` (the ensemble's seed axis over per-seed index batches)
+    # folds seeds into the kernel's date grid axis — one pallas_call with
+    # S·D grid rows. JAX's generic batching rule would instead wrap the
+    # scalar-prefetch call in a lax.scan, serializing S kernel dispatches
+    # per train step and breaking the DMA pipeline at each seed boundary.
+
+    @custom_vmap
+    def call(xm, firm_idx, time_idx):
+        return call_flat(xm, firm_idx, time_idx)
+
+    @call.def_vmap
+    def _call_vmap(axis_size, in_batched, xm, firm_idx, time_idx):
+        xm_b, fi_b, ti_b = in_batched
+        if not fi_b:
+            firm_idx = jnp.broadcast_to(firm_idx,
+                                        (axis_size,) + firm_idx.shape)
+        if not ti_b:
+            time_idx = jnp.broadcast_to(time_idx,
+                                        (axis_size,) + time_idx.shape)
+        if xm_b:
+            # Per-seed panels: nothing to fold (the kernel reads ONE panel
+            # from HBM). Rare/unused in-tree; keep the serial semantics.
+            return jax.lax.map(
+                lambda args: call_flat(*args), (xm, firm_idx, time_idx)
+            ), True
+        S, D, bf_ = firm_idx.shape
+        out = call_flat(xm, firm_idx.reshape(S * D, bf_),
+                        time_idx.reshape(S * D))
+        return out.reshape(S, D, *out.shape[1:]), True
 
     return call
 

@@ -144,3 +144,35 @@ def test_device_panel_lane_pad_pads_months():
     # (it only returns "pallas" on a real TPU, but must not trip on the
     # aligned-span check for any T residue).
     assert resolve_gather_impl("auto", None, panel, W) in ("xla", "pallas")
+
+
+def test_vmap_folds_seeds_into_one_kernel():
+    """vmap over per-seed index batches (the ensemble) must fold seeds
+    into the kernel's date grid axis — ONE pallas_call, no lax.scan
+    serialization — and match the per-seed results exactly."""
+    import jax
+
+    T, S, D, Bf = 72, 4, 3, 8
+    xm = _packed_panel(T)
+    rng = np.random.default_rng(7)
+    fi = jnp.asarray(rng.integers(0, N_FIRMS, (S, D, Bf)).astype(np.int32))
+    ti = jnp.asarray(rng.integers(W - 3, T - 1, (S, D)).astype(np.int32))
+
+    def g(a, b):
+        return gather_windows_pallas(xm, a, b, window=W, interpret=True)
+
+    jaxpr = str(jax.make_jaxpr(jax.vmap(g))(fi, ti))
+    assert jaxpr.count("pallas_call") == 1
+    assert " scan[" not in jaxpr
+
+    x, m = jax.vmap(g)(fi, ti)
+    for s in range(S):
+        xr, mr = g(fi[s], ti[s])
+        np.testing.assert_array_equal(np.asarray(x[s]), np.asarray(xr))
+        np.testing.assert_array_equal(np.asarray(m[s]), np.asarray(mr))
+
+    # Shared firm indices with per-seed anchors (mixed batching).
+    x2, m2 = jax.vmap(lambda b: g(fi[0], b))(ti)
+    xr, mr = g(fi[0], ti[2])
+    np.testing.assert_array_equal(np.asarray(x2[2]), np.asarray(xr))
+    np.testing.assert_array_equal(np.asarray(m2[2]), np.asarray(mr))
