@@ -130,6 +130,9 @@ class RNNModel(nn.Module):
     # VMEM across all T steps; opaque to GSPMD, so use it single-device or
     # inside shard_map.
     scan_impl: str = "xla"
+    # Batch rows per Pallas grid block (None = rnn_scan's default); the
+    # tuning knob scripts/sweep_rnn_blocks.py measures.
+    scan_block_b: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, m, deterministic: bool = True):
@@ -165,6 +168,7 @@ class RNNModel(nn.Module):
                     xw.reshape((-1, W, xw.shape[-1])),
                     wh,
                     m.reshape((-1, W)),
+                    block_b=self.scan_block_b,
                 ).reshape(xw.shape[:-1] + (self.hidden,))
                 continue
             scan = nn.scan(

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Sweep the Pallas RNN kernel's batch block size (and the gather's firm
+block) at the config-2 train geometry on the real chip, printing one JSON
+line per point — the tuning evidence behind rnn_scan's block_b default.
+
+The trade: bigger blocks mean larger `[bb, H] @ [H, G·H]` MXU matmuls and
+fewer grid steps, but more VMEM per pipeline stage (xw block = bb·G·H
+bytes, double-buffered) and less DMA/compute overlap across blocks.
+
+Run: python scripts/sweep_rnn_blocks.py [bb ...]   (default sweep below)
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import measure_trainer  # noqa: E402
+
+
+def sweep(block_sizes) -> None:
+    from lfm_quant_tpu.config import get_preset
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.train import Trainer
+
+    base = get_preset("c2")
+    d = base.data
+    panel = synthetic_panel(n_firms=d.n_firms, n_months=240,
+                            n_features=d.n_features, horizon=d.horizon,
+                            seed=0)
+    splits = PanelSplits.by_date(panel, 198601, 198801)
+    best = (None, 0.0)
+    for bb in block_sizes:
+        kw = dict(base.model.kwargs)
+        if bb:
+            kw["scan_block_b"] = bb
+        cfg = dataclasses.replace(
+            base, model=dataclasses.replace(base.model, kwargs=kw))
+        try:
+            value = measure_trainer(Trainer(cfg, splits))
+        except Exception as e:  # noqa: BLE001 — report the point, keep going
+            print(json.dumps({"block_b": bb, "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+            continue
+        print(json.dumps({"block_b": bb or "default",
+                          "value": round(value, 1),
+                          "unit": "firm-months/sec/chip"}), flush=True)
+        if value > best[1]:
+            best = (bb, value)
+    print(json.dumps({"best_block_b": best[0] or "default",
+                      "value": round(best[1], 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    sizes = [int(a) for a in sys.argv[1:]] or [None, 256, 512, 1024, 2048]
+    sweep(sizes)
