@@ -126,12 +126,27 @@ def measure_ensemble_trainer(trainer, k: int = 10, reps: int = 3) -> float:
     return fm / dt
 
 
+def _scan_impl_override(cfg):
+    """LFM_BENCH_SCAN_IMPL=xla|pallas|pallas_fused overrides the RNN scan
+    implementation — the on-chip validation/measurement hook for kernel
+    variants (README "kernel caveat": new BlockSpecs/grids must run on a
+    real chip once before they count)."""
+    import dataclasses as _dc
+
+    impl = os.environ.get("LFM_BENCH_SCAN_IMPL")
+    if not impl:
+        return cfg
+    kw = dict(cfg.model.kwargs)
+    kw["scan_impl"] = impl
+    return _dc.replace(cfg, model=_dc.replace(cfg.model, kwargs=kw))
+
+
 def bench_c2() -> None:
     from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.data import PanelSplits, synthetic_panel
     from lfm_quant_tpu.train import Trainer
 
-    cfg = get_preset("c2")
+    cfg = _scan_impl_override(get_preset("c2"))
     # Bench panel: full config-2 feature/window geometry, trimmed months so
     # panel generation isn't the bench bottleneck.
     d = cfg.data
@@ -155,7 +170,7 @@ def bench_c5_ensemble() -> None:
     from lfm_quant_tpu.data import PanelSplits, synthetic_panel
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
 
-    cfg = get_preset("c5")
+    cfg = _scan_impl_override(get_preset("c5"))
     n_seeds = int(os.environ.get("LFM_BENCH_SEEDS", "16"))
     cfg = _dc.replace(cfg, n_seeds=n_seeds)
     d = cfg.data

@@ -53,7 +53,10 @@ class ModelConfig:
     # RNN recurrence implementation: "auto" picks the fused Pallas kernel
     # (ops/pallas_rnn.py) on TPU when no GSPMD mesh is in play (a
     # pallas_call is opaque to the partitioner), else the XLA lax.scan.
-    scan_impl: str = "auto"  # auto | xla | pallas
+    # auto | xla | pallas | pallas_fused ("auto" = pallas on TPU, xla
+    # elsewhere; pallas_fused additionally computes the gate input
+    # projection in-kernel — opt-in until its on-chip numbers land).
+    scan_impl: str = "auto"
 
 
 @dataclasses.dataclass

@@ -329,6 +329,39 @@ def _sidx(extent: int):
     return (lambda s: s) if extent > 1 else (lambda s: 0)
 
 
+def _pinned_spec(block_shape, extent: int) -> pl.BlockSpec:
+    """Whole-array VMEM block (weights/bias) that varies only with the seed
+    coordinate — pinned to 0 for seed-shared (size-1) operands."""
+    sx = _sidx(extent)
+    zeros = (0,) * (len(block_shape) - 1)
+    return pl.BlockSpec(block_shape, lambda s, i, t: (sx(s),) + zeros,
+                        memory_space=pltpu.VMEM)
+
+
+def _rev(T: int, sx):
+    """Reverse-time index map (grid step t ↦ real time T-1-t)."""
+    return lambda s, i, t: (sx(s), T - 1 - t, i, 0)
+
+
+def _rev_prev(T: int, sx):
+    """Reverse-time map shifted one step earlier, clamped at 0 (the t=0
+    read is overridden with the zero initial state in the kernels)."""
+    return lambda s, i, t: (sx(s), jnp.maximum(T - 2 - t, 0), i, 0)
+
+
+def _to_time_major(x, m, bb_pad: int):
+    """[.., B, T, ·] batch-major → ([.., T, Bp, ·], [.., T, Bp, 1]) time-
+    major with the batch dim zero-padded by ``bb_pad`` rows (padded rows
+    are masked out, so they contribute zero to every gradient)."""
+    x_t = jnp.swapaxes(x, -3, -2)
+    m_t = jnp.swapaxes(m, -2, -1)[..., None]
+    if bb_pad:
+        pad = [(0, 0)] * (x_t.ndim - 2) + [(0, bb_pad), (0, 0)]
+        x_t = jnp.pad(x_t, pad)
+        m_t = jnp.pad(m_t, pad)
+    return x_t, m_t
+
+
 def _fwd_call(cell: str, xw_t, wh, m_t, forget_bias, bb, interpret):
     """Run the forward kernel on seed-stacked time-major inputs.
 
@@ -341,12 +374,11 @@ def _fwd_call(cell: str, xw_t, wh, m_t, forget_bias, bb, interpret):
     H = G // _GATES[cell]
     grid = (S, Bp // bb, T)
     vmem = pltpu.VMEM
-    sx, sw, sm = _sidx(xw_t.shape[0]), _sidx(wh.shape[0]), _sidx(m_t.shape[0])
+    sx, sm = _sidx(xw_t.shape[0]), _sidx(m_t.shape[0])
     in_specs = [
         pl.BlockSpec((1, 1, bb, G), lambda s, i, t: (sx(s), t, i, 0),
                      memory_space=vmem),
-        pl.BlockSpec((1, H, G), lambda s, i, t: (sw(s), 0, 0),
-                     memory_space=vmem),
+        _pinned_spec((1, H, G), wh.shape[0]),
         pl.BlockSpec((1, 1, bb, 1), lambda s, i, t: (sm(s), t, i, 0),
                      memory_space=vmem),
     ]
@@ -382,13 +414,8 @@ def _bwd_call(cell: str, xw_t, wh, m_t, saved, dh_t, forget_bias, bb,
     S = _seed_extent("rnn_scan bwd", xw_t, wh, m_t, *saved, dh_t)
     H = G // _GATES[cell]
     grid = (S, Bp // bb, T)
-
-    def rev(sx):
-        return lambda s, i, t: (sx(s), T - 1 - t, i, 0)
-
-    def rev_prev(sx):
-        return lambda s, i, t: (sx(s), jnp.maximum(T - 2 - t, 0), i, 0)
-
+    rev = functools.partial(_rev, T)
+    rev_prev = functools.partial(_rev_prev, T)
     vmem = pltpu.VMEM
 
     def state_spec(n):
@@ -398,13 +425,10 @@ def _bwd_call(cell: str, xw_t, wh, m_t, saved, dh_t, forget_bias, bb,
         return pl.BlockSpec((1, 1, bb, H), rev_prev(_sidx(n)),
                             memory_space=vmem)
 
-    sw = _sidx(wh.shape[0])
-    wh_spec = pl.BlockSpec((1, H, G), lambda s, i, t: (sw(s), 0, 0),
-                           memory_space=vmem)
     in_specs = [
         pl.BlockSpec((1, 1, bb, G), rev(_sidx(xw_t.shape[0])),
                      memory_space=vmem),
-        wh_spec,
+        _pinned_spec((1, H, G), wh.shape[0]),
         pl.BlockSpec((1, 1, bb, 1), rev(_sidx(m_t.shape[0])),
                      memory_space=vmem),
     ]
@@ -458,16 +482,6 @@ def _make_scan(cell: str, forget_bias: float, block_b: Optional[int],
     ``vmap(grad(...))``; the reverse nesting breaks reverse-mode AD.
     """
 
-    def to_time_major(xw, m, bb_pad):
-        # [.., B, T, G] batch-major → [.., T, Bp, G] time-major padded.
-        xw_t = jnp.swapaxes(xw, -3, -2)
-        m_t = jnp.swapaxes(m, -2, -1)[..., None]
-        if bb_pad:
-            pad = [(0, 0)] * (xw_t.ndim - 2) + [(0, bb_pad), (0, 0)]
-            xw_t = jnp.pad(xw_t, pad)
-            m_t = jnp.pad(m_t, pad)
-        return xw_t, m_t
-
     # ---- forward op: [S|1, B, T, G] stacked impl shared by the
     # unbatched (S = 1) and vmapped (seed-axis) paths. Besides the kernel
     # outputs it returns the time-major padded xw_t/m_t views so the
@@ -477,7 +491,7 @@ def _make_scan(cell: str, forget_bias: float, block_b: Optional[int],
     def fwd_stacked(xw, wh, m):
         B = xw.shape[-3]
         Bp, bb = _blocks(B, block_b)
-        xw_t, m_t = to_time_major(xw, m, Bp - B)
+        xw_t, m_t = _to_time_major(xw, m, Bp - B)
         return (xw_t, m_t) + _fwd_call(cell, xw_t, wh, m_t, forget_bias,
                                        bb, interpret)
 
@@ -589,3 +603,413 @@ def rnn_scan(cell: str, xw: jax.Array, wh: jax.Array, m: jax.Array, *,
     # function: a bool primal would demand a float0 cotangent from bwd.
     return _make_scan(cell, float(forget_bias), block_b, bool(interpret))(
         xw, wh, m.astype(xw.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Fused-projection variant: the input projection moves INSIDE the kernel.
+#
+# The plain ``rnn_scan`` consumes the hoisted gate projection
+# ``xw = x @ Wx + b`` — a [B, T, G·H] tensor that a separate GEMM writes to
+# HBM and the kernel streams back in. At G·H = 4·128 that round-trip is the
+# single largest HBM flow in the train step (~4× the embed activations it
+# was projected from). ``rnn_scan_fused`` streams the H-wide layer input
+# instead and computes the projection per step next to the recurrent
+# matmul: HBM traffic drops ~3× for the same FLOPs placement (two
+# [bb, H] @ [H, G·H] MXU dots per step instead of one), and the backward
+# kernel produces the H-wide ``d h_in`` plus in-VMEM dWx/dWh/db
+# accumulators. Same masking semantics, same custom_vmap seed-axis
+# dispatch, same checkpoint-compatible parameter tree
+# (models/rnn.py scan_impl="pallas_fused").
+# ---------------------------------------------------------------------------
+
+
+def _lstm_fused_fwd_kernel(hin_ref, wx_ref, b_ref, wh_ref, m_ref, h_out,
+                           c_out, h_s, c_s, *, forget_bias: float):
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[...] = jnp.zeros_like(h_s)
+        c_s[...] = jnp.zeros_like(c_s)
+
+    h, c = h_s[...], c_s[...]
+    gates = (jnp.dot(hin_ref[0, 0], wx_ref[0],
+                     preferred_element_type=jnp.float32)
+             + b_ref[0, 0].astype(jnp.float32)
+             + jnp.dot(h.astype(wh_ref.dtype), wh_ref[0],
+                       preferred_element_type=jnp.float32))
+    i, f, g, o = _lstm_gates(gates, forget_bias)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    keep = m_ref[0, 0].astype(jnp.float32)
+    h = keep * h_new + (1.0 - keep) * h
+    c = keep * c_new + (1.0 - keep) * c
+    h_s[...], c_s[...] = h, c
+    h_out[0, 0] = h.astype(h_out.dtype)
+    c_out[0, 0] = c.astype(c_out.dtype)
+
+
+def _gru_fused_fwd_kernel(hin_ref, wx_ref, b_ref, wh_ref, m_ref, h_out, h_s):
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[...] = jnp.zeros_like(h_s)
+
+    h = h_s[...]
+    xw = (jnp.dot(hin_ref[0, 0], wx_ref[0],
+                  preferred_element_type=jnp.float32)
+          + b_ref[0, 0].astype(jnp.float32))
+    hw = jnp.dot(h.astype(wh_ref.dtype), wh_ref[0],
+                 preferred_element_type=jnp.float32)
+    z, r, n, _ = _gru_parts(xw, hw)
+    h_new = (1.0 - z) * n + z * h
+    keep = m_ref[0, 0].astype(jnp.float32)
+    h = keep * h_new + (1.0 - keep) * h
+    h_s[...] = h
+    h_out[0, 0] = h.astype(h_out.dtype)
+
+
+def _lstm_fused_bwd_kernel(hin_ref, wx_ref, b_ref, wh_ref, m_ref, hp_ref,
+                           cp_ref, cc_ref, dh_ref, dhin_ref, dwx_ref,
+                           dwh_ref, db_ref, dh_s, dc_s, *,
+                           forget_bias: float):
+    t = pl.program_id(2)
+    T = pl.num_programs(2)
+
+    @pl.when(t == 0)
+    def _():
+        dh_s[...] = jnp.zeros_like(dh_s)
+        dc_s[...] = jnp.zeros_like(dc_s)
+
+    @pl.when((pl.program_id(1) == 0) & (t == 0))
+    def _():
+        dwx_ref[...] = jnp.zeros_like(dwx_ref)
+        dwh_ref[...] = jnp.zeros_like(dwh_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    first = t == T - 1
+    h_prev = jnp.where(first, 0.0, hp_ref[0, 0].astype(jnp.float32))
+    c_prev = jnp.where(first, 0.0, cp_ref[0, 0].astype(jnp.float32))
+    c_cur = cc_ref[0, 0].astype(jnp.float32)
+    keep = m_ref[0, 0].astype(jnp.float32)
+    hin = hin_ref[0, 0]
+
+    gates = (jnp.dot(hin, wx_ref[0], preferred_element_type=jnp.float32)
+             + b_ref[0, 0].astype(jnp.float32)
+             + jnp.dot(h_prev.astype(wh_ref.dtype), wh_ref[0],
+                       preferred_element_type=jnp.float32))
+    i, f, g, o = _lstm_gates(gates, forget_bias)
+
+    dh_t = dh_ref[0, 0].astype(jnp.float32) + dh_s[...]
+    dc_t = dc_s[...]
+    dh_new = keep * dh_t
+    dc_new = keep * dc_t
+    tc = jnp.tanh(c_cur)
+    do = dh_new * tc
+    dc_tot = dc_new + dh_new * o * (1.0 - tc * tc)
+    di = dc_tot * g
+    df = dc_tot * c_prev
+    dg = dc_tot * i
+    d_gates = jnp.concatenate([
+        di * i * (1.0 - i),
+        df * f * (1.0 - f),
+        dg * (1.0 - g * g),
+        do * o * (1.0 - o),
+    ], axis=-1)
+    dhin_ref[0, 0] = jax.lax.dot_general(
+        d_gates, wx_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dhin_ref.dtype)
+    dh_s[...] = (1.0 - keep) * dh_t + jax.lax.dot_general(
+        d_gates, wh_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dc_s[...] = (1.0 - keep) * dc_t + dc_tot * f
+    dwx_ref[0] += jax.lax.dot_general(
+        hin.astype(jnp.float32), d_gates,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dwh_ref[0] += jax.lax.dot_general(
+        h_prev, d_gates, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    db_ref[0, 0] += d_gates.sum(axis=0)
+
+
+def _gru_fused_bwd_kernel(hin_ref, wx_ref, b_ref, wh_ref, m_ref, hp_ref,
+                          dh_ref, dhin_ref, dwx_ref, dwh_ref, db_ref, dh_s):
+    t = pl.program_id(2)
+    T = pl.num_programs(2)
+
+    @pl.when(t == 0)
+    def _():
+        dh_s[...] = jnp.zeros_like(dh_s)
+
+    @pl.when((pl.program_id(1) == 0) & (t == 0))
+    def _():
+        dwx_ref[...] = jnp.zeros_like(dwx_ref)
+        dwh_ref[...] = jnp.zeros_like(dwh_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    first = t == T - 1
+    h_prev = jnp.where(first, 0.0, hp_ref[0, 0].astype(jnp.float32))
+    keep = m_ref[0, 0].astype(jnp.float32)
+    hin = hin_ref[0, 0]
+
+    xw = (jnp.dot(hin, wx_ref[0], preferred_element_type=jnp.float32)
+          + b_ref[0, 0].astype(jnp.float32))
+    hw = jnp.dot(h_prev.astype(wh_ref.dtype), wh_ref[0],
+                 preferred_element_type=jnp.float32)
+    z, r, n, hn = _gru_parts(xw, hw)
+
+    dh_t = dh_ref[0, 0].astype(jnp.float32) + dh_s[...]
+    dh_new = keep * dh_t
+    dz = dh_new * (h_prev - n)
+    dn_raw = dh_new * (1.0 - z) * (1.0 - n * n)
+    dr = dn_raw * hn
+    d_hz = dz * z * (1.0 - z)
+    d_hr = dr * r * (1.0 - r)
+    d_hn = dn_raw * r
+    d_hw = jnp.concatenate([d_hz, d_hr, d_hn], axis=-1)
+    d_xw = jnp.concatenate([d_hz, d_hr, dn_raw], axis=-1)
+    dhin_ref[0, 0] = jax.lax.dot_general(
+        d_xw, wx_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dhin_ref.dtype)
+    dh_s[...] = (1.0 - keep) * dh_t + dh_new * z + jax.lax.dot_general(
+        d_hw, wh_ref[0].astype(jnp.float32),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dwx_ref[0] += jax.lax.dot_general(
+        hin.astype(jnp.float32), d_xw,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dwh_ref[0] += jax.lax.dot_general(
+        h_prev, d_hw, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    db_ref[0, 0] += d_xw.sum(axis=0)
+
+
+def _fused_fwd_call(cell, hin_t, wx, b, wh, m_t, forget_bias, bb, interpret):
+    """Fused forward on seed-stacked time-major inputs: hin_t
+    [S|1, T, Bp, H]; wx/wh [S|1, H, G·H]; b [S|1, 1, G·H]; m_t
+    [S|1, T, Bp, 1] → h_all (+ c_all) [S, T, Bp, H]."""
+    _, T, Bp, H = hin_t.shape
+    S = _seed_extent("rnn_scan_fused", hin_t, wx, b, wh, m_t)
+    G = wx.shape[-1]
+    grid = (S, Bp // bb, T)
+    vmem = pltpu.VMEM
+    shin, sm = _sidx(hin_t.shape[0]), _sidx(m_t.shape[0])
+    in_specs = [
+        pl.BlockSpec((1, 1, bb, H), lambda s, i, t: (shin(s), t, i, 0),
+                     memory_space=vmem),
+        _pinned_spec((1, H, G), wx.shape[0]),
+        _pinned_spec((1, 1, G), b.shape[0]),
+        _pinned_spec((1, H, G), wh.shape[0]),
+        pl.BlockSpec((1, 1, bb, 1), lambda s, i, t: (sm(s), t, i, 0),
+                     memory_space=vmem),
+    ]
+    state_spec = pl.BlockSpec((1, 1, bb, H), lambda s, i, t: (s, t, i, 0),
+                              memory_space=vmem)
+    state_shape = jax.ShapeDtypeStruct((S, T, Bp, H), hin_t.dtype)
+    scratch = pltpu.VMEM((bb, H), jnp.float32)
+    if cell == "lstm":
+        return pl.pallas_call(
+            functools.partial(_lstm_fused_fwd_kernel,
+                              forget_bias=forget_bias),
+            grid=grid, in_specs=in_specs,
+            out_specs=(state_spec, state_spec),
+            out_shape=(state_shape, state_shape),
+            scratch_shapes=[scratch, scratch],
+            interpret=interpret,
+        )(hin_t, wx, b, wh, m_t)
+    return (pl.pallas_call(
+        _gru_fused_fwd_kernel,
+        grid=grid, in_specs=in_specs,
+        out_specs=state_spec, out_shape=state_shape,
+        scratch_shapes=[scratch],
+        interpret=interpret,
+    )(hin_t, wx, b, wh, m_t),)
+
+
+def _fused_bwd_call(cell, hin_t, wx, b, wh, m_t, saved, dh_t, forget_bias,
+                    bb, interpret):
+    """Reverse-time fused backward → (dhin_t [S,T,Bp,H], dwx f32 [S,H,G],
+    dwh f32 [S,H,G], db f32 [S,1,G])."""
+    _, T, Bp, H = hin_t.shape
+    S = _seed_extent("rnn_scan_fused bwd", hin_t, wx, b, wh, m_t, *saved,
+                     dh_t)
+    G = wx.shape[-1]
+    grid = (S, Bp // bb, T)
+    rev = functools.partial(_rev, T)
+    rev_prev = functools.partial(_rev_prev, T)
+    vmem = pltpu.VMEM
+
+    def state_spec(n):
+        return pl.BlockSpec((1, 1, bb, H), rev(_sidx(n)), memory_space=vmem)
+
+    def prev_spec(n):
+        return pl.BlockSpec((1, 1, bb, H), rev_prev(_sidx(n)),
+                            memory_space=vmem)
+
+    in_specs = [
+        state_spec(hin_t.shape[0]),
+        _pinned_spec((1, H, G), wx.shape[0]),
+        _pinned_spec((1, 1, G), b.shape[0]),
+        _pinned_spec((1, H, G), wh.shape[0]),
+        pl.BlockSpec((1, 1, bb, 1), rev(_sidx(m_t.shape[0])),
+                     memory_space=vmem),
+    ]
+    if cell == "lstm":
+        h_all, c_all = saved
+        in_specs += [prev_spec(h_all.shape[0]), prev_spec(c_all.shape[0]),
+                     state_spec(c_all.shape[0])]
+        inputs = (hin_t, wx, b, wh, m_t, h_all, c_all, c_all, dh_t)
+        kernel = functools.partial(_lstm_fused_bwd_kernel,
+                                   forget_bias=forget_bias)
+        n_scratch = 2
+    else:
+        (h_all,) = saved
+        in_specs += [prev_spec(h_all.shape[0])]
+        inputs = (hin_t, wx, b, wh, m_t, h_all, dh_t)
+        kernel = _gru_fused_bwd_kernel
+        n_scratch = 1
+    in_specs.append(state_spec(dh_t.shape[0]))  # dh upstream
+    ident = lambda s, i, t: (s, 0, 0)  # noqa: E731
+    dhin_t, dwx, dwh, db = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((1, 1, bb, H), rev(lambda s: s),
+                         memory_space=vmem),
+            pl.BlockSpec((1, H, G), ident, memory_space=vmem),
+            pl.BlockSpec((1, H, G), ident, memory_space=vmem),
+            pl.BlockSpec((1, 1, G), ident, memory_space=vmem),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((S, T, Bp, H), hin_t.dtype),
+            jax.ShapeDtypeStruct((S, H, G), jnp.float32),
+            jax.ShapeDtypeStruct((S, H, G), jnp.float32),
+            jax.ShapeDtypeStruct((S, 1, G), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((bb, H), jnp.float32)] * n_scratch,
+        interpret=interpret,
+    )(*inputs)
+    return dhin_t, dwx, dwh, db
+
+
+@functools.lru_cache(maxsize=None)
+def _make_fused_scan(cell: str, forget_bias: float, block_b: Optional[int],
+                     interpret: bool):
+    """custom-VJP fused-projection scan (same structure as _make_scan)."""
+
+    def fwd_stacked(hin, wx, b, wh, m):
+        B = hin.shape[-3]
+        Bp, bb = _blocks(B, block_b)
+        hin_t, m_t = _to_time_major(hin, m, Bp - B)
+        return (hin_t, m_t) + _fused_fwd_call(
+            cell, hin_t, wx, b, wh, m_t, forget_bias, bb, interpret)
+
+    @custom_vmap
+    def fwd_op(hin, wx, b, wh, m):
+        out = fwd_stacked(hin[None], wx[None], b[None], wh[None], m[None])
+        return tuple(s[0] for s in out)
+
+    @fwd_op.def_vmap
+    def _fwd_vmap(axis_size, in_batched, hin, wx, b, wh, m):
+        hin_t, m_t, *kout = fwd_stacked(
+            _seed_axis(in_batched[0], hin), _seed_axis(in_batched[1], wx),
+            _seed_axis(in_batched[2], b), _seed_axis(in_batched[3], wh),
+            _seed_axis(in_batched[4], m))
+        kout = _ensure_seed(kout, axis_size)
+        hin_t = hin_t if in_batched[0] else hin_t[0]
+        m_t = m_t if in_batched[4] else m_t[0]
+        return ((hin_t, m_t, *kout),
+                (in_batched[0], in_batched[4]) + (True,) * len(kout))
+
+    def bwd_stacked(hin_t, wx, b, wh, m_t, saved, dh):
+        Bp = hin_t.shape[-2]
+        B = dh.shape[-3]
+        _, bb = _blocks(B, block_b)
+        dh_t = jnp.swapaxes(dh, -3, -2)
+        if Bp != B:
+            pad = [(0, 0)] * (dh_t.ndim - 2) + [(0, Bp - B), (0, 0)]
+            dh_t = jnp.pad(dh_t, pad)
+        dhin_t, dwx, dwh, db = _fused_bwd_call(
+            cell, hin_t, wx, b, wh, m_t, saved, dh_t.astype(hin_t.dtype),
+            forget_bias, bb, interpret)
+        return jnp.swapaxes(dhin_t, 1, 2)[:, :B], dwx, dwh, db
+
+    @custom_vmap
+    def bwd_op(hin_t, wx, b, wh, m_t, saved, dh):
+        dhin, dwx, dwh, db = bwd_stacked(
+            hin_t[None], wx[None], b[None], wh[None], m_t[None],
+            tuple(s[None] for s in saved), dh[None])
+        return dhin[0], dwx[0], dwh[0], db[0]
+
+    @bwd_op.def_vmap
+    def _bwd_vmap(axis_size, in_batched, hin_t, wx, b, wh, m_t, saved, dh):
+        out = bwd_stacked(
+            _seed_axis(in_batched[0], hin_t),
+            _seed_axis(in_batched[1], wx),
+            _seed_axis(in_batched[2], b),
+            _seed_axis(in_batched[3], wh),
+            _seed_axis(in_batched[4], m_t),
+            tuple(_seed_axis(bt, s)
+                  for bt, s in zip(in_batched[5], saved)),
+            _seed_axis(in_batched[6], dh))
+        return _ensure_seed(out, axis_size), (True,) * 4
+
+    @jax.custom_vjp
+    def scan(hin, wx, b, wh, m):
+        out = fwd_op(hin, wx, b, wh, m)
+        return jnp.swapaxes(out[2], 0, 1)[:hin.shape[0]]
+
+    def fwd(hin, wx, b, wh, m):
+        out = fwd_op(hin, wx, b, wh, m)
+        h = jnp.swapaxes(out[2], 0, 1)[:hin.shape[0]]
+        return h, (out[0], wx, b, wh, out[1], out[2:])
+
+    def bwd(res, dh):
+        hin_t, wx, b, wh, m_t, saved = res
+        dhin, dwx, dwh, db = bwd_op(hin_t, wx, b, wh, m_t, saved, dh)
+        # b's primal inside scan is [1, G] (rnn_scan_fused adds the axis).
+        return (dhin, dwx.astype(wx.dtype), db.astype(b.dtype),
+                dwh.astype(wh.dtype), jnp.zeros(dh.shape[:-1], dh.dtype))
+
+    scan.defvjp(fwd, bwd)
+    return scan
+
+
+def rnn_scan_fused(cell: str, hin: jax.Array, wx: jax.Array, b: jax.Array,
+                   wh: jax.Array, m: jax.Array, *, forget_bias: float = 1.0,
+                   block_b: Optional[int] = None,
+                   interpret: Optional[bool] = None) -> jax.Array:
+    """Fused masked recurrence with the gate input projection computed
+    in-kernel (differentiable; see the section comment for why).
+
+    Args:
+      cell: "lstm" | "gru".
+      hin: ``[B, T, H]`` layer input (the embed/previous-layer output).
+      wx: ``[H, G·H]`` gate input-projection weights.
+      b: ``[G·H]`` gate bias.
+      wh: ``[H, G·H]`` recurrent gate weights.
+      m: ``[B, T]`` step validity; invalid steps hold state.
+      forget_bias / block_b / interpret: as :func:`rnn_scan`.
+
+    Returns ``[B, T, H]`` per-step hidden states in ``hin.dtype``.
+    """
+    if cell not in _GATES:
+        raise ValueError(f"cell must be one of {sorted(_GATES)}")
+    H = hin.shape[-1]
+    G = _GATES[cell] * H
+    if wx.shape != (H, G) or wh.shape != (H, G) or b.shape != (G,):
+        raise ValueError(
+            f"expected wx/wh [{H},{G}] and b [{G}], got "
+            f"{wx.shape}/{wh.shape}/{b.shape}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _make_fused_scan(cell, float(forget_bias), block_b,
+                            bool(interpret))(
+        hin, wx, b[None], wh, m.astype(hin.dtype))

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Sweep the Pallas RNN kernel's batch block size (and the gather's firm
-block) at the config-2 train geometry on the real chip, printing one JSON
-line per point — the tuning evidence behind rnn_scan's block_b default.
+"""Sweep the Pallas RNN kernel's batch block size at the config-2 train
+geometry on the real chip, printing one JSON line per point — the tuning
+evidence behind rnn_scan's block_b default. Set LFM_BENCH_SCAN_IMPL=
+pallas_fused to sweep the fused-projection variant instead.
 
 The trade: bigger blocks mean larger `[bb, H] @ [H, G·H]` MXU matmuls and
 fewer grid steps, but more VMEM per pipeline stage (xw block = bb·G·H
@@ -17,7 +18,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import measure_trainer  # noqa: E402
+from bench import _scan_impl_override, measure_trainer  # noqa: E402
 
 
 def sweep(block_sizes) -> None:
@@ -36,8 +37,8 @@ def sweep(block_sizes) -> None:
         kw = dict(base.model.kwargs)
         if bb:
             kw["scan_block_b"] = bb
-        cfg = dataclasses.replace(
-            base, model=dataclasses.replace(base.model, kwargs=kw))
+        cfg = _scan_impl_override(dataclasses.replace(
+            base, model=dataclasses.replace(base.model, kwargs=kw)))
         try:
             value = measure_trainer(Trainer(cfg, splits))
         except Exception as e:  # noqa: BLE001 — report the point, keep going

@@ -185,3 +185,100 @@ def test_vmap_multi_block_batch():
     ref = jnp.stack([rnn_scan_reference(cell, xw[s], wh[s], m[s])
                      for s in range(2)])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Fused-projection variant (rnn_scan_fused / scan_impl="pallas_fused"):
+# identical parameter tree, the gate input projection computed in-kernel.
+# ---------------------------------------------------------------------------
+
+from lfm_quant_tpu.ops.pallas_rnn import rnn_scan_fused  # noqa: E402
+
+
+@pytest.mark.parametrize("cell", CELLS)
+def test_fused_matches_reference(cell):
+    rng = np.random.default_rng(11)
+    B, T, H = 13, 6, 8
+    G = GATES[cell] * H
+    hin = jnp.asarray(rng.standard_normal((B, T, H)).astype(np.float32))
+    wx = jnp.asarray(0.3 * rng.standard_normal((H, G)).astype(np.float32))
+    b = jnp.asarray(0.1 * rng.standard_normal((G,)).astype(np.float32))
+    wh = jnp.asarray(0.3 * rng.standard_normal((H, G)).astype(np.float32))
+    m = jnp.asarray(rng.random((B, T)) < 0.75)
+    out = rnn_scan_fused(cell, hin, wx, b, wh, m)
+    ref = rnn_scan_reference(cell, hin @ wx + b, wh, m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+@pytest.mark.parametrize("cell", CELLS)
+def test_fused_gradients_match_reference(cell):
+    rng = np.random.default_rng(12)
+    B, T, H = 9, 5, 8
+    G = GATES[cell] * H
+    hin = jnp.asarray(rng.standard_normal((B, T, H)).astype(np.float32))
+    wx = jnp.asarray(0.3 * rng.standard_normal((H, G)).astype(np.float32))
+    b = jnp.asarray(0.1 * rng.standard_normal((G,)).astype(np.float32))
+    wh = jnp.asarray(0.3 * rng.standard_normal((H, G)).astype(np.float32))
+    m = jnp.asarray((rng.random((B, T)) < 0.75).astype(np.float32))
+
+    def loss(hin, wx, b, wh, m):
+        return (rnn_scan_fused(cell, hin, wx, b, wh, m) ** 2).sum()
+
+    def loss_ref(hin, wx, b, wh, m):
+        return (rnn_scan_reference(cell, hin @ wx + b, wh, m) ** 2).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(hin, wx, b, wh, m)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))(hin, wx, b, wh, m)
+    for got, want in zip(g, gr):
+        scale = float(jnp.abs(want).max()) + 1e-9
+        np.testing.assert_allclose(np.asarray(got) / scale,
+                                   np.asarray(want) / scale, atol=1e-5)
+
+
+@pytest.mark.parametrize("cell", CELLS)
+def test_fused_vmap_grad_matches_reference(cell):
+    """jit(vmap(grad(...))) over all operands — the ensemble composition."""
+    rng = np.random.default_rng(13)
+    S, B, T, H = 3, 7, 5, 8
+    G = GATES[cell] * H
+    hin = jnp.asarray(rng.standard_normal((S, B, T, H)).astype(np.float32))
+    wx = jnp.asarray(0.3 * rng.standard_normal((S, H, G)).astype(np.float32))
+    b = jnp.asarray(0.1 * rng.standard_normal((S, G)).astype(np.float32))
+    wh = jnp.asarray(0.3 * rng.standard_normal((S, H, G)).astype(np.float32))
+    m = jnp.asarray((rng.random((S, B, T)) < 0.75).astype(np.float32))
+
+    def loss(hin, wx, b, wh, m):
+        return (rnn_scan_fused(cell, hin, wx, b, wh, m) ** 2).sum()
+
+    def loss_ref(hin, wx, b, wh, m):
+        return (rnn_scan_reference(cell, hin @ wx + b, wh, m) ** 2).sum()
+
+    g = jax.jit(jax.vmap(jax.grad(loss, argnums=(1, 2, 3))))(
+        hin, wx, b, wh, m)
+    gr = jax.jit(jax.vmap(jax.grad(loss_ref, argnums=(1, 2, 3))))(
+        hin, wx, b, wh, m)
+    for got, want in zip(g, gr):
+        scale = float(jnp.abs(want).max()) + 1e-9
+        np.testing.assert_allclose(np.asarray(got) / scale,
+                                   np.asarray(want) / scale, atol=1e-5)
+
+
+@pytest.mark.parametrize("cell", CELLS)
+def test_model_fused_equals_xla(cell):
+    """RNNModel(scan_impl='pallas_fused') must share the XLA path's exact
+    parameter tree and outputs — checkpoint interchange both ways."""
+    rng = np.random.default_rng(14)
+    x = jnp.asarray(rng.standard_normal((9, 8, 5)).astype(np.float32))
+    m = jnp.asarray(rng.random((9, 8)) < 0.8)
+    mk = dict(hidden=12, layers=2)
+    xla = build_model(cell, **mk)
+    fused = build_model(cell, scan_impl="pallas_fused", **mk)
+    params = xla.init(jax.random.key(0), x, m)["params"]
+    p2 = fused.init(jax.random.key(0), x, m)["params"]
+    assert jax.tree.structure(params) == jax.tree.structure(p2)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+    out_x = xla.apply({"params": params}, x, m)
+    out_f = fused.apply({"params": params}, x, m)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_x),
+                               atol=1e-5)
