@@ -30,8 +30,15 @@ def main(argv=None) -> int:
     ap.add_argument("--costs-bps", type=float, default=0.0)
     ap.add_argument("--mode", default="mean",
                     choices=["mean", "mean_minus_std"],
-                    help="ensemble aggregation (ensemble run dirs only)")
+                    help="aggregation over seeds (ensemble run dirs) or "
+                         "MC-dropout samples (--mc-samples)")
     ap.add_argument("--risk-lambda", type=float, default=1.0)
+    ap.add_argument("--mc-samples", type=int, default=0,
+                    help="single-model run dirs: draw this many MC-dropout "
+                         "forecast samples (model must have dropout > 0) "
+                         "and aggregate them with --mode, the "
+                         "uncertainty-aware-LFM alternative to a seed "
+                         "ensemble")
     ap.add_argument("--json-out", default=None,
                     help="write the full report JSON here")
     args = ap.parse_args(argv)
@@ -39,6 +46,10 @@ def main(argv=None) -> int:
     from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
 
     is_ensemble = os.path.exists(os.path.join(args.run_dir, "ensemble.flag"))
+    if is_ensemble and args.mc_samples > 0:
+        ap.error("--mc-samples applies to single-model run dirs only; this "
+                 "is a seed ensemble — its uncertainty comes from the "
+                 "seeds (use --mode mean_minus_std directly)")
     if is_ensemble:
         from lfm_quant_tpu.train.ensemble import load_ensemble
         ens, splits = load_ensemble(args.run_dir)
@@ -48,7 +59,13 @@ def main(argv=None) -> int:
     else:
         from lfm_quant_tpu.train.loop import load_trainer
         trainer, splits = load_trainer(args.run_dir)
-        forecast, fc_valid = trainer.predict(args.split)
+        if args.mc_samples > 0:
+            stacked, fc_valid = trainer.predict(
+                args.split, mc_samples=args.mc_samples)
+            forecast, fc_valid = aggregate_ensemble(
+                stacked, fc_valid, args.mode, args.risk_lambda)
+        else:
+            forecast, fc_valid = trainer.predict(args.split)
 
     report = run_backtest(
         forecast, fc_valid, splits.panel,

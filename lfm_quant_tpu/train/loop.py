@@ -323,6 +323,7 @@ class Trainer:
             self._jit_multi_step = jax.jit(self._shard_mapped(
                 self._multi_step_impl, steps_axis=True))
         self._jit_forward = jax.jit(self._forward_impl)
+        self._jit_forward_mc = None  # built on first predict(mc_samples>0)
 
     def _shard_mapped(self, impl, steps_axis: bool):
         """Wrap a step impl in shard_map over this trainer's mesh.
@@ -423,13 +424,17 @@ class Trainer:
 
         return jax.lax.scan(body, state, (fi, ti, w))
 
-    def _forward_impl(self, params, dev: dict, firm_idx, time_idx, weight):
+    def _forward_impl(self, params, dev: dict, firm_idx, time_idx, weight,
+                      rng=None):
         """Eval forward: returns (pred [D,Bf], per-month IC [D], mse scalar).
 
         Chunked over the date axis with ``lax.map``: eval sweeps stack ALL
         months into one [M, bf] batch, and the fast gather materializes
         full firm histories ([chunk, bf, T, F]) — unchunked that would be
         T/W × the window bytes for every eval month at once.
+
+        ``rng`` switches dropout LIVE (per-chunk keys) — the MC-dropout
+        sampling path of :meth:`predict`; None is the deterministic eval.
         """
         M = firm_idx.shape[0]
         C = min(self.cfg.data.dates_per_batch, M)
@@ -440,21 +445,24 @@ class Trainer:
             weight = jnp.concatenate(
                 [weight, jnp.zeros_like(weight[:pad])], axis=0)
         nc = firm_idx.shape[0] // C
-        chunks = (firm_idx.reshape(nc, C, -1), time_idx.reshape(nc, C),
-                  weight.reshape(nc, C, -1))
+        chunks = [firm_idx.reshape(nc, C, -1), time_idx.reshape(nc, C),
+                  weight.reshape(nc, C, -1)]
+        if rng is not None:
+            chunks.append(jax.random.split(rng, nc))
 
         def chunk(args):
-            fi, ti, w = args
+            fi, ti, w, *key = args
             x, m = self._gather(dev["xm"], fi, ti,
                                 impl=self._eval_gather_impl)
             y = gather_targets(dev["targets"], fi, ti)
             pred = _point_forecast(
-                self._apply(params, x, m, model=self.eval_model))
+                self._apply(params, x, m, model=self.eval_model,
+                            rng=key[0] if key else None))
             ic = spearman_ic(pred, y, w)
             se = (w * (pred.astype(jnp.float32) - y) ** 2).sum(axis=-1)
             return pred, ic, se, w.sum(axis=-1)
 
-        pred, ic, se, ws = jax.lax.map(chunk, chunks)
+        pred, ic, se, ws = jax.lax.map(chunk, tuple(chunks))
         pred = pred.reshape(nc * C, -1)[:M]
         ic = ic.reshape(-1)[:M]
         se, ws = se.reshape(-1)[:M], ws.reshape(-1)[:M]
@@ -572,31 +580,58 @@ class Trainer:
             "history": history,
         }
 
-    def predict(self, split: str = "test") -> Tuple[np.ndarray, np.ndarray]:
+    def predict(self, split: str = "test", mc_samples: int = 0,
+                mc_seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
         """Forecasts for every eligible anchor in a split's date range.
 
         Returns (forecast [N, T] float32, pred_valid [N, T] bool) over the
         FULL panel shape, with pred_valid True only inside the split range —
         the backtest engine's input (SURVEY.md §4.3).
+
+        ``mc_samples > 0`` switches to **MC-dropout sampling** (the
+        uncertainty-aware LFM lineage's single-model alternative to deep
+        ensembles, SURVEY.md §1 [BACKGROUND]): the forward runs that many
+        times with dropout live and independent keys, returning stacked
+        forecasts ``[K, N, T]`` shaped exactly like
+        ``EnsembleTrainer.predict`` so ``aggregate_ensemble`` (mean /
+        mean−λ·std) consumes either. Requires a model with dropout > 0.
         """
         d = self.cfg.data
         panel = self.splits.panel
+        if mc_samples > 0 and not self.cfg.model.kwargs.get("dropout", 0.0):
+            raise ValueError(
+                "mc_samples > 0 needs a model with dropout > 0 "
+                "(ModelConfig.kwargs['dropout']); this run has none, so "
+                "every sample would be identical")
         sampler = DateBatchSampler(
             panel, d.window, 1, d.firms_per_date, seed=0,
             min_valid_months=d.min_valid_months, min_cross_section=1,
             date_range=self.splits.range_of(split),
         )
-        out = np.zeros((panel.n_firms, panel.n_months), np.float32)
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
         b = sampler.stacked_cross_sections()
         fi, ti, w = self._batch_args(b)
-        pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
-        pred = np.asarray(pred)  # [M, bf]
         real = b.weight > 0  # [M, bf]
         rows = b.firm_idx[real]
         cols = np.broadcast_to(b.time_idx[:, None], b.firm_idx.shape)[real]
-        out[rows, cols] = pred[real]
         out_valid[rows, cols] = True
+
+        if mc_samples > 0:
+            if self._jit_forward_mc is None:
+                self._jit_forward_mc = jax.jit(self._forward_impl)
+            out = np.zeros((mc_samples, panel.n_firms, panel.n_months),
+                           np.float32)
+            key = jax.random.key(mc_seed)
+            for k in range(mc_samples):
+                pred, _, _ = self._jit_forward_mc(
+                    self.state.params, self.dev, fi, ti, w,
+                    jax.random.fold_in(key, k))
+                out[k][rows, cols] = np.asarray(pred)[real]
+            return out, out_valid
+
+        out = np.zeros((panel.n_firms, panel.n_months), np.float32)
+        pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
+        out[rows, cols] = np.asarray(pred)[real]
         return out, out_valid
 
 

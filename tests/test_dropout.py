@@ -124,3 +124,55 @@ def test_transformer_dropout_trains(splits, tmp_path):
     b = next(iter(t.train_sampler.epoch(0)))
     _, m = t._jit_step(s, t.dev, *t._batch_args(b))
     assert np.isfinite(float(m["loss"]))
+
+
+# ---------------------------------------------------------------------------
+# MC-dropout inference (Trainer.predict(mc_samples=K)) — the uncertainty-
+# aware-LFM single-model alternative to a seed ensemble.
+# ---------------------------------------------------------------------------
+
+
+def _fitted(splits, tmp, dropout):
+    t = Trainer(_cfg(tmp, dropout), splits)
+    t.state = t.init_state()
+    return t
+
+
+def test_mc_predict_shapes_and_diversity(splits, tmp_path):
+    t = _fitted(splits, tmp_path / "mc", 0.5)
+    stacked, valid = t.predict("test", mc_samples=4, mc_seed=7)
+    n, tm = splits.panel.n_firms, splits.panel.n_months
+    assert stacked.shape == (4, n, tm) and valid.shape == (n, tm)
+    assert valid.any()
+    # Dropout live → samples differ where predictions exist.
+    sd = stacked.std(axis=0)[valid]
+    assert float(sd.max()) > 0.0
+    # Same seed → bit-identical replay; different seed → different draws.
+    again, _ = t.predict("test", mc_samples=4, mc_seed=7)
+    np.testing.assert_array_equal(stacked, again)
+    other, _ = t.predict("test", mc_samples=4, mc_seed=8)
+    assert not np.array_equal(stacked, other)
+
+
+def test_mc_predict_aggregates_like_ensemble(splits, tmp_path):
+    from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
+
+    t = _fitted(splits, tmp_path / "mcagg", 0.5)
+    stacked, valid = t.predict("test", mc_samples=3)
+    fc, v = aggregate_ensemble(stacked, valid, "mean_minus_std", 1.0)
+    assert fc.shape == v.shape == valid.shape
+    report = run_backtest(fc, v, splits.panel, quantile=0.3, min_universe=5)
+    assert report.n_months > 0
+
+
+def test_mc_predict_requires_dropout(splits, tmp_path):
+    t = _fitted(splits, tmp_path / "mcno", 0.0)
+    with pytest.raises(ValueError, match="dropout"):
+        t.predict("test", mc_samples=4)
+
+
+def test_mc_predict_validity_matches_plain(splits, tmp_path):
+    t = _fitted(splits, tmp_path / "mceq", 0.5)
+    _, v_mc = t.predict("test", mc_samples=2)
+    _, v = t.predict("test")
+    np.testing.assert_array_equal(v_mc, v)
