@@ -323,7 +323,6 @@ class Trainer:
             self._jit_multi_step = jax.jit(self._shard_mapped(
                 self._multi_step_impl, steps_axis=True))
         self._jit_forward = jax.jit(self._forward_impl)
-        self._jit_forward_mc = None  # built on first predict(mc_samples>0)
 
     def _shard_mapped(self, impl, steps_axis: bool):
         """Wrap a step impl in shard_map over this trainer's mesh.
@@ -454,14 +453,21 @@ class Trainer:
             fi, ti, w, *key = args
             x, m = self._gather(dev["xm"], fi, ti,
                                 impl=self._eval_gather_impl)
-            y = gather_targets(dev["targets"], fi, ti)
             pred = _point_forecast(
                 self._apply(params, x, m, model=self.eval_model,
                             rng=key[0] if key else None))
+            if rng is not None:
+                # Sampling path: only the forecasts are consumed — skip
+                # the per-month ranking/error metrics K times over.
+                return pred
+            y = gather_targets(dev["targets"], fi, ti)
             ic = spearman_ic(pred, y, w)
             se = (w * (pred.astype(jnp.float32) - y) ** 2).sum(axis=-1)
             return pred, ic, se, w.sum(axis=-1)
 
+        if rng is not None:
+            pred = jax.lax.map(chunk, tuple(chunks))
+            return pred.reshape(nc * C, -1)[:M], None, None
         pred, ic, se, ws = jax.lax.map(chunk, tuple(chunks))
         pred = pred.reshape(nc * C, -1)[:M]
         ic = ic.reshape(-1)[:M]
@@ -617,13 +623,13 @@ class Trainer:
         out_valid[rows, cols] = True
 
         if mc_samples > 0:
-            if self._jit_forward_mc is None:
-                self._jit_forward_mc = jax.jit(self._forward_impl)
+            # Same jitted eval forward; the 6-arg (rng) signature gets its
+            # own cached trace with dropout live and metrics skipped.
             out = np.zeros((mc_samples, panel.n_firms, panel.n_months),
                            np.float32)
             key = jax.random.key(mc_seed)
             for k in range(mc_samples):
-                pred, _, _ = self._jit_forward_mc(
+                pred, _, _ = self._jit_forward(
                     self.state.params, self.dev, fi, ti, w,
                     jax.random.fold_in(key, k))
                 out[k][rows, cols] = np.asarray(pred)[real]

@@ -159,3 +159,17 @@ def test_nll_loss_with_heteroscedastic_head(panel, tmp_path):
     )
     summary, _, _ = run_experiment(cfg, panel=panel)
     assert np.isfinite(summary["history"][-1]["train_loss"])
+
+
+def test_bench_scan_impl_override(monkeypatch):
+    """LFM_BENCH_SCAN_IMPL must reroute the benched model's scan_impl —
+    the on-chip validation hook for new kernel variants."""
+    import bench
+    from lfm_quant_tpu.config import get_preset
+
+    monkeypatch.setenv("LFM_BENCH_SCAN_IMPL", "pallas_fused")
+    cfg = bench._scan_impl_override(get_preset("c2"))
+    assert cfg.model.kwargs["scan_impl"] == "pallas_fused"
+    monkeypatch.delenv("LFM_BENCH_SCAN_IMPL")
+    cfg = bench._scan_impl_override(get_preset("c2"))
+    assert "scan_impl" not in cfg.model.kwargs
