@@ -282,3 +282,30 @@ def test_model_fused_equals_xla(cell):
     out_f = fused.apply({"params": params}, x, m)
     np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_x),
                                atol=1e-5)
+
+
+def test_fused_multi_block_and_padding():
+    """Fused variant across multiple batch grid blocks + a non-multiple
+    batch (padded rows must not pollute dWx/dWh/db)."""
+    cell = "lstm"
+    rng = np.random.default_rng(15)
+    B, T, H = 21, 5, 8  # block_b=8 → 3 blocks, 3 padded rows
+    G = GATES[cell] * H
+    hin = jnp.asarray(rng.standard_normal((B, T, H)).astype(np.float32))
+    wx = jnp.asarray(0.3 * rng.standard_normal((H, G)).astype(np.float32))
+    b = jnp.asarray(0.1 * rng.standard_normal((G,)).astype(np.float32))
+    wh = jnp.asarray(0.3 * rng.standard_normal((H, G)).astype(np.float32))
+    m = jnp.asarray((rng.random((B, T)) < 0.75).astype(np.float32))
+
+    def loss(hin, wx, b, wh, m):
+        return (rnn_scan_fused(cell, hin, wx, b, wh, m, block_b=8) ** 2).sum()
+
+    def loss_ref(hin, wx, b, wh, m):
+        return (rnn_scan_reference(cell, hin @ wx + b, wh, m) ** 2).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(hin, wx, b, wh, m)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2, 3)))(hin, wx, b, wh, m)
+    for got, want in zip(g, gr):
+        scale = float(jnp.abs(want).max()) + 1e-9
+        np.testing.assert_allclose(np.asarray(got) / scale,
+                                   np.asarray(want) / scale, atol=1e-5)
