@@ -21,10 +21,18 @@ import sys
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--run-dir", required=True,
-                    help="run directory written by train.py")
-    ap.add_argument("--split", default="test", choices=["test", "val", "train"],
-                    help="which date split to simulate on")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--run-dir",
+                     help="run directory written by train.py")
+    src.add_argument("--forecast-npz",
+                     help="stitched forecast file written by walk-forward "
+                          "mode (train.py --walk-forward): walkforward.npz "
+                          "or its directory; the sibling config.json "
+                          "resolves the panel")
+    ap.add_argument("--split", default=None, choices=["test", "val", "train"],
+                    help="which date split to simulate on (default: test; "
+                         "not applicable with --forecast-npz, whose months "
+                         "are fixed by the stitched file)")
     ap.add_argument("--quantile", type=float, default=0.1)
     ap.add_argument("--long-short", action="store_true")
     ap.add_argument("--costs-bps", type=float, default=0.0)
@@ -45,30 +53,61 @@ def main(argv=None) -> int:
 
     from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
 
-    is_ensemble = os.path.exists(os.path.join(args.run_dir, "ensemble.flag"))
-    if is_ensemble and args.mc_samples > 0:
-        ap.error("--mc-samples applies to single-model run dirs only; this "
-                 "is a seed ensemble — its uncertainty comes from the "
-                 "seeds (use --mode mean_minus_std directly)")
-    if is_ensemble:
-        from lfm_quant_tpu.train.ensemble import load_ensemble
-        ens, splits = load_ensemble(args.run_dir)
-        stacked, stacked_valid = ens.predict(args.split)
-        forecast, fc_valid = aggregate_ensemble(
-            stacked, stacked_valid, args.mode, args.risk_lambda)
-    else:
-        from lfm_quant_tpu.train.loop import load_trainer
-        trainer, splits = load_trainer(args.run_dir)
+    if args.forecast_npz:
+        import numpy as np
+
+        from lfm_quant_tpu.config import RunConfig
+        from lfm_quant_tpu.train.loop import resolve_panel
+
         if args.mc_samples > 0:
-            stacked, fc_valid = trainer.predict(
-                args.split, mc_samples=args.mc_samples)
+            ap.error("--mc-samples needs a live model; a forecast file is "
+                     "already sampled/stitched")
+        if args.split is not None:
+            ap.error("--split does not apply to --forecast-npz: the "
+                     "simulated months are fixed by the stitched file")
+        path = args.forecast_npz
+        if os.path.isdir(path):
+            path = os.path.join(path, "walkforward.npz")
+        with open(os.path.join(os.path.dirname(path), "config.json")) as fh:
+            cfg = RunConfig.from_json(fh.read())
+        data = np.load(path)
+        forecast, fc_valid = data["forecast"], data["valid"]
+        panel = resolve_panel(cfg.data)
+        if forecast.ndim == 3:  # stacked walk-forward ensemble
             forecast, fc_valid = aggregate_ensemble(
-                stacked, fc_valid, args.mode, args.risk_lambda)
+                forecast, fc_valid, args.mode, args.risk_lambda)
+        elif args.mode != "mean":
+            ap.error(f"--mode {args.mode} needs stacked forecasts; this "
+                     "file holds a single model's (already-aggregated) "
+                     "walk-forward forecasts")
+    else:
+        is_ensemble = os.path.exists(
+            os.path.join(args.run_dir, "ensemble.flag"))
+        if is_ensemble and args.mc_samples > 0:
+            ap.error("--mc-samples applies to single-model run dirs only; "
+                     "this is a seed ensemble — its uncertainty comes from "
+                     "the seeds (use --mode mean_minus_std directly)")
+        split = args.split or "test"
+        if is_ensemble:
+            from lfm_quant_tpu.train.ensemble import load_ensemble
+            ens, splits = load_ensemble(args.run_dir)
+            stacked, stacked_valid = ens.predict(split)
+            forecast, fc_valid = aggregate_ensemble(
+                stacked, stacked_valid, args.mode, args.risk_lambda)
         else:
-            forecast, fc_valid = trainer.predict(args.split)
+            from lfm_quant_tpu.train.loop import load_trainer
+            trainer, splits = load_trainer(args.run_dir)
+            if args.mc_samples > 0:
+                stacked, fc_valid = trainer.predict(
+                    split, mc_samples=args.mc_samples)
+                forecast, fc_valid = aggregate_ensemble(
+                    stacked, fc_valid, args.mode, args.risk_lambda)
+            else:
+                forecast, fc_valid = trainer.predict(split)
+        panel = splits.panel
 
     report = run_backtest(
-        forecast, fc_valid, splits.panel,
+        forecast, fc_valid, panel,
         quantile=args.quantile, long_short=args.long_short,
         costs_bps=args.costs_bps,
     )

@@ -276,16 +276,19 @@ class EnsembleTrainer:
 
     # ---- inference -----------------------------------------------------
 
-    def predict(self, split: str = "test") -> Tuple[np.ndarray, np.ndarray]:
+    def predict(self, split: str = "test",
+                date_range: Optional[Tuple[int, int]] = None
+                ) -> Tuple[np.ndarray, np.ndarray]:
         """Stacked forecasts [S, N, T] + shared validity [N, T] over the
-        split's anchor range, for the backtest's ensemble aggregation
-        (SURVEY.md §4.3)."""
+        split's anchor range (or an explicit month-index ``date_range`` —
+        the walk-forward fold window), for the backtest's ensemble
+        aggregation (SURVEY.md §4.3)."""
         d = self.cfg.data
         panel = self.splits.panel
         sampler = DateBatchSampler(
             panel, d.window, 1, d.firms_per_date, seed=0,
             min_valid_months=d.min_valid_months, min_cross_section=1,
-            date_range=self.splits.range_of(split),
+            date_range=date_range or self.splits.range_of(split),
         )
         out = np.zeros((self.n_seeds, panel.n_firms, panel.n_months), np.float32)
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)

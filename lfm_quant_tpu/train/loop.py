@@ -587,7 +587,8 @@ class Trainer:
         }
 
     def predict(self, split: str = "test", mc_samples: int = 0,
-                mc_seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+                mc_seed: int = 0, date_range: Optional[Tuple[int, int]] = None
+                ) -> Tuple[np.ndarray, np.ndarray]:
         """Forecasts for every eligible anchor in a split's date range.
 
         Returns (forecast [N, T] float32, pred_valid [N, T] bool) over the
@@ -601,6 +602,10 @@ class Trainer:
         forecasts ``[K, N, T]`` shaped exactly like
         ``EnsembleTrainer.predict`` so ``aggregate_ensemble`` (mean /
         mean−λ·std) consumes either. Requires a model with dropout > 0.
+
+        ``date_range`` (month-INDEX pair, end-exclusive) overrides the
+        split's anchor range — the walk-forward harness predicts each
+        fold's bounded out-of-sample block with it.
         """
         d = self.cfg.data
         panel = self.splits.panel
@@ -612,7 +617,7 @@ class Trainer:
         sampler = DateBatchSampler(
             panel, d.window, 1, d.firms_per_date, seed=0,
             min_valid_months=d.min_valid_months, min_cross_section=1,
-            date_range=self.splits.range_of(split),
+            date_range=date_range or self.splits.range_of(split),
         )
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
         b = sampler.stacked_cross_sections()

@@ -1,0 +1,188 @@
+"""Walk-forward retraining — the multi-decade out-of-sample protocol.
+
+The reference lineage backtests 1970–2024 (BASELINE.json:5). A single
+train/val/test split over five decades leaks regime information: one
+model, selected once, is graded on thirty years it never had to adapt
+to — and its validation period sits decades before most of the test
+months. The standard protocol (SURVEY.md §2 L5 "experiment
+orchestration") is walk-forward: at each fold, train on everything up to
+``train_end``, early-stop on the next ``val_months``, forecast ONLY the
+following ``step_months``, then roll forward and retrain. Stitching the
+per-fold forecasts yields one out-of-sample forecast panel where every
+prediction comes from a model that saw strictly earlier data — the input
+``backtest.py`` grades.
+
+TPU notes: each fold is a full Trainer/EnsembleTrainer run over the SAME
+HBM-resident panel (PanelSplits never slices, so fold boundaries are
+free); the per-fold prediction window is a bounded month-index range
+passed straight to ``predict(date_range=...)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from lfm_quant_tpu.config import RunConfig
+from lfm_quant_tpu.data.panel import Panel, PanelSplits
+
+
+def month_add(yyyymm: int, months: int) -> int:
+    """Calendar-correct YYYYMM arithmetic (months may be negative)."""
+    y, m = divmod(yyyymm, 100)
+    t = y * 12 + (m - 1) + months
+    return (t // 12) * 100 + t % 12 + 1
+
+
+def walkforward_folds(panel: Panel, start: int, step_months: int,
+                      val_months: int,
+                      n_folds: Optional[int] = None
+                      ) -> List[Tuple[int, int, Tuple[int, int]]]:
+    """Fold schedule: [(train_end, val_end, (pred_lo_idx, pred_hi_idx))].
+
+    Fold k trains on anchors < train_end (embargoed by the horizon, see
+    PanelSplits), validates on [train_end, val_end), and forecasts the
+    month-INDEX range [pred_lo, pred_hi) covering the ``step_months``
+    right after val_end. Folds advance by ``step_months``, so prediction
+    windows tile the out-of-sample period without overlap. The schedule
+    stops once a fold's window would start inside the panel's final
+    ``horizon`` months — anchors there have no realized target yet
+    (windows.py anchor_index), so they are neither predictable by the
+    samplers nor gradeable by the backtest.
+    """
+    if step_months < 1:
+        raise ValueError(f"step_months must be >= 1, got {step_months}")
+    if val_months < 1:
+        raise ValueError(f"val_months must be >= 1, got {val_months}")
+    dates = panel.dates
+    usable = panel.n_months - panel.horizon  # last month with a target
+    folds = []
+    train_end = start
+    while n_folds is None or len(folds) < n_folds:
+        val_end = month_add(train_end, val_months)
+        test_end = month_add(val_end, step_months)
+        lo = int(np.searchsorted(dates, val_end))
+        hi = int(np.searchsorted(dates, test_end))
+        if lo >= usable or lo == hi:
+            break  # no gradeable out-of-sample months left
+        folds.append((train_end, val_end, (lo, hi)))
+        train_end = month_add(train_end, step_months)
+    if not folds:
+        raise ValueError(
+            f"no walk-forward folds fit: start={start} val={val_months}mo "
+            f"step={step_months}mo vs panel [{dates[0]}, {dates[-1]}]")
+    return folds
+
+
+def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
+                    step_months: int = 12, val_months: int = 24,
+                    n_folds: Optional[int] = None, out_dir: Optional[str] = None,
+                    echo: bool = False, resume: bool = False
+                    ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
+    """Train a model (or seed ensemble, ``cfg.n_seeds > 1``) per fold and
+    stitch the out-of-sample forecasts.
+
+    Returns ``(forecast, valid, summary)`` where forecast is [N, T]
+    (single) or [S, N, T] (ensemble — aggregate downstream exactly like
+    ``EnsembleTrainer.predict`` output), valid is [N, T] and True only in
+    the stitched out-of-sample months, and summary carries per-fold
+    records. When ``out_dir`` is set, each fold's run dir lands under
+    ``<out_dir>/fold_<k>``, a progress snapshot (``partial.npz`` +
+    ``partial.json``) is written after every fold, and ``walkforward.npz``
+    + ``summary.json`` at the end.
+
+    ``resume=True`` (needs ``out_dir``) skips folds already recorded in
+    the progress snapshot and resumes the in-flight fold from its own
+    ``ckpt/latest`` — crash recovery for multi-fold runs.
+    """
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+    from lfm_quant_tpu.train.loop import Trainer
+
+    folds = walkforward_folds(panel, start, step_months, val_months, n_folds)
+    ensemble = cfg.n_seeds > 1
+    lead = (cfg.n_seeds,) if ensemble else ()
+    forecast = np.zeros(lead + (panel.n_firms, panel.n_months), np.float32)
+    valid = np.zeros((panel.n_firms, panel.n_months), bool)
+    records: List[Dict[str, Any]] = []
+
+    partial_npz = os.path.join(out_dir, "partial.npz") if out_dir else None
+    partial_json = os.path.join(out_dir, "partial.json") if out_dir else None
+    if resume:
+        if not out_dir:
+            raise ValueError("resume=True needs out_dir (the progress "
+                             "snapshot lives there)")
+        if os.path.exists(partial_npz):
+            snap = np.load(partial_npz)
+            forecast, valid = snap["forecast"], snap["valid"].astype(bool)
+            with open(partial_json) as fh:
+                records = json.load(fh)
+            if len(records) > len(folds):
+                raise ValueError(
+                    f"resume fold schedule mismatch: snapshot has "
+                    f"{len(records)} folds, new schedule only "
+                    f"{len(folds)} — same start/step/val arguments "
+                    "required")
+            for rec, fold in zip(records, folds):
+                if (rec["train_end"], rec["val_end"]) != fold[:2]:
+                    raise ValueError(
+                        "resume fold schedule mismatch: snapshot fold "
+                        f"{rec['fold']} is (train_end={rec['train_end']}, "
+                        f"val_end={rec['val_end']}), schedule says "
+                        f"{fold[:2]} — same start/step/val arguments "
+                        "required")
+            if forecast.shape != lead + (panel.n_firms, panel.n_months):
+                raise ValueError("resume snapshot shape mismatch "
+                                 f"{forecast.shape} — n_seeds changed?")
+
+    for k, (train_end, val_end, pred_range) in enumerate(folds):
+        if k < len(records):
+            continue  # fold already completed in a previous run
+        splits = PanelSplits.by_date(panel, train_end, val_end)
+        run_dir = os.path.join(out_dir, f"fold_{k}") if out_dir else None
+        # Per-fold seed offset keeps fold models independent draws while
+        # staying replayable.
+        fold_cfg = dataclasses.replace(cfg, seed=cfg.seed + 1000 * k)
+        trainer = (EnsembleTrainer if ensemble else Trainer)(
+            fold_cfg, splits, run_dir=run_dir, echo=echo)
+        fit = trainer.fit(resume=resume and run_dir is not None)
+        fc, v = trainer.predict(date_range=pred_range)
+        assert not (valid & v).any(), "fold prediction windows overlap"
+        forecast[..., v] = fc[..., v]
+        valid |= v
+        records.append({
+            "fold": k,
+            "train_end": train_end,
+            "val_end": val_end,
+            "pred_months": [int(panel.dates[pred_range[0]]),
+                            int(panel.dates[pred_range[1] - 1])],
+            "n_pred_cells": int(v.sum()),
+            "best_val_ic": fit["best_val_ic"],
+            "epochs_run": fit["epochs_run"],
+        })
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            np.savez_compressed(partial_npz, forecast=forecast, valid=valid)
+            with open(partial_json, "w") as fh:
+                json.dump(records, fh)
+    summary = {
+        "n_folds": len(folds),
+        "step_months": step_months,
+        "val_months": val_months,
+        "n_seeds": cfg.n_seeds,
+        "oos_months": [int(panel.dates[folds[0][2][0]]),
+                       int(panel.dates[folds[-1][2][1] - 1])],
+        "folds": records,
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        np.savez_compressed(os.path.join(out_dir, "walkforward.npz"),
+                            forecast=forecast, valid=valid)
+        with open(os.path.join(out_dir, "config.json"), "w") as fh:
+            fh.write(cfg.to_json())
+        with open(os.path.join(out_dir, "summary.json"), "w") as fh:
+            json.dump(summary, fh, indent=2)
+    return forecast, valid, summary

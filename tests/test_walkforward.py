@@ -1,0 +1,155 @@
+"""Walk-forward retraining (train/walkforward.py): fold schedule math,
+no-lookahead stitching, ensemble stacking, and the CLI round-trip into
+backtest.py --forecast-npz."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.train.walkforward import (
+    month_add,
+    run_walkforward,
+    walkforward_folds,
+)
+
+
+def _cfg(tmp, n_seeds=1):
+    return RunConfig(
+        name="wf",
+        data=DataConfig(n_firms=100, n_months=200, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=2, warmup_steps=5, loss="mse"),
+        seed=0,
+        n_seeds=n_seeds,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=100, n_months=200, n_features=5, seed=5)
+
+
+def test_month_add():
+    assert month_add(197001, 12) == 197101
+    assert month_add(197011, 3) == 197102
+    assert month_add(197001, -1) == 196912
+    assert month_add(199912, 1) == 200001
+
+
+def test_fold_schedule_tiles_without_overlap(panel):
+    folds = walkforward_folds(panel, start=198001, step_months=12,
+                              val_months=24)
+    assert len(folds) >= 2
+    prev_hi = None
+    for train_end, val_end, (lo, hi) in folds:
+        assert month_add(train_end, 24) == val_end
+        assert lo < hi
+        if prev_hi is not None:
+            assert lo == prev_hi  # windows tile exactly
+        prev_hi = hi
+    # The schedule covers the gradeable period: the next fold would start
+    # inside the final horizon months (no realized targets there).
+    usable = panel.n_months - panel.horizon
+    assert folds[-1][2][1] <= panel.n_months
+    next_lo = folds[-1][2][1]
+    assert next_lo >= usable or next_lo == panel.n_months
+
+
+def test_fold_schedule_rejects_empty(panel):
+    with pytest.raises(ValueError, match="no walk-forward folds"):
+        walkforward_folds(panel, start=299001, step_months=12, val_months=24)
+
+
+def test_walkforward_stitches_oos_only(panel, tmp_path):
+    cfg = _cfg(tmp_path)
+    fc, valid, summary = run_walkforward(
+        cfg, panel, start=198001, step_months=12, val_months=24, n_folds=2,
+        out_dir=str(tmp_path / "wf"))
+    assert fc.shape == (panel.n_firms, panel.n_months)
+    assert summary["n_folds"] == 2
+    # Valid cells only inside the stitched out-of-sample month range.
+    dates = panel.dates
+    lo = int(np.searchsorted(dates, month_add(198001, 24)))
+    hi = int(np.searchsorted(dates, month_add(198001, 24 + 2 * 12)))
+    assert valid[:, lo:hi].any()
+    assert not valid[:, :lo].any() and not valid[:, hi:].any()
+    # Forecasts exist exactly where valid.
+    assert (fc[~valid] == 0).all()
+    # Artifacts on disk.
+    data = np.load(tmp_path / "wf" / "walkforward.npz")
+    np.testing.assert_array_equal(data["forecast"], fc)
+    assert (tmp_path / "wf" / "summary.json").exists()
+    assert (tmp_path / "wf" / "config.json").exists()
+
+
+def test_walkforward_ensemble_stacks_seeds(panel, tmp_path):
+    cfg = _cfg(tmp_path, n_seeds=2)
+    fc, valid, summary = run_walkforward(
+        cfg, panel, start=198001, step_months=12, val_months=24, n_folds=2)
+    assert fc.shape == (2, panel.n_firms, panel.n_months)
+    # Members differ where predictions exist (ensemble diversity).
+    assert float(fc.std(axis=0)[valid].max()) > 0.0
+
+
+def test_cli_roundtrip_backtest_forecast_npz(tmp_path):
+    import json
+
+    import backtest as bt_cli
+
+    from lfm_quant_tpu.train.loop import resolve_panel
+
+    cfg = _cfg(tmp_path)
+    # The panel MUST come from the config (resolve_panel) so backtest.py
+    # regenerates the identical panel from the saved config.json —
+    # exactly what train.py --walk-forward does.
+    panel = resolve_panel(cfg.data)
+    run_walkforward(cfg, panel, start=198001, step_months=12, val_months=24,
+                    n_folds=2, out_dir=str(tmp_path / "wf"))
+    # resolve_panel must rebuild the same synthetic panel from the config.
+    cfg_json = json.load(open(tmp_path / "wf" / "config.json"))
+    assert cfg_json["data"]["n_firms"] == 100
+    rc = bt_cli.main(["--forecast-npz", str(tmp_path / "wf"),
+                      "--quantile", "0.3",
+                      "--json-out", str(tmp_path / "rep.json")])
+    assert rc == 0
+    rep = json.load(open(tmp_path / "rep.json"))
+    assert rep["n_months"] > 0
+
+
+def test_walkforward_resume_skips_completed_folds(panel, tmp_path):
+    cfg = _cfg(tmp_path)
+    out = str(tmp_path / "wfres")
+    fc1, v1, s1 = run_walkforward(
+        cfg, panel, start=198001, step_months=12, val_months=24, n_folds=1,
+        out_dir=out)
+    # Resume with one more fold: fold 0 must be taken from the snapshot.
+    fc2, v2, s2 = run_walkforward(
+        cfg, panel, start=198001, step_months=12, val_months=24, n_folds=2,
+        out_dir=out, resume=True)
+    assert s2["n_folds"] == 2
+    assert s2["folds"][0] == s1["folds"][0]
+    # Fold-0 forecasts carried over bit-identically; fold 1 added.
+    np.testing.assert_array_equal(fc2[..., v1], fc1[..., v1])
+    assert v2.sum() > v1.sum()
+
+
+def test_walkforward_resume_rejects_schedule_mismatch(panel, tmp_path):
+    cfg = _cfg(tmp_path)
+    out = str(tmp_path / "wfmm")
+    run_walkforward(cfg, panel, start=198001, step_months=12, val_months=24,
+                    n_folds=1, out_dir=out)
+    with pytest.raises(ValueError, match="schedule mismatch"):
+        run_walkforward(cfg, panel, start=198101, step_months=12,
+                        val_months=24, n_folds=2, out_dir=out, resume=True)
+
+
+def test_walkforward_rejects_bad_step(panel):
+    with pytest.raises(ValueError, match="step_months"):
+        walkforward_folds(panel, start=198001, step_months=0, val_months=24)
+    with pytest.raises(ValueError, match="step_months"):
+        walkforward_folds(panel, start=198001, step_months=-12, val_months=24)

@@ -38,7 +38,26 @@ def main(argv=None) -> int:
                     help="sanitizer mode: raise on any NaN/Inf inside jit")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="write a jax.profiler trace (Perfetto) to DIR")
+    ap.add_argument("--walk-forward", metavar="STEP_MONTHS", type=int,
+                    default=None,
+                    help="walk-forward mode: retrain every STEP_MONTHS "
+                         "months and stitch the out-of-sample forecasts "
+                         "(train/walkforward.py); writes walkforward.npz "
+                         "for backtest.py --forecast-npz")
+    ap.add_argument("--wf-start", type=int, default=None,
+                    help="first fold's train_end (YYYYMM; default: 60%% "
+                         "through the panel)")
+    ap.add_argument("--wf-val-months", type=int, default=24,
+                    help="validation window per fold (months)")
+    ap.add_argument("--wf-folds", type=int, default=None,
+                    help="cap the number of folds (default: run to the "
+                         "panel's end)")
     args = ap.parse_args(argv)
+    if args.walk_forward is None and (
+            args.wf_start is not None or args.wf_folds is not None
+            or args.wf_val_months != 24):
+        ap.error("--wf-start/--wf-val-months/--wf-folds need "
+                 "--walk-forward STEP_MONTHS")
 
     # Import late so --help works instantly without initializing JAX.
     import dataclasses
@@ -82,7 +101,22 @@ def main(argv=None) -> int:
         if args.debug:
             ctx.enter_context(sanitized())
         ctx.enter_context(trace_context(args.profile))
-        if cfg.n_seeds > 1:
+        if args.walk_forward is not None:
+            import os
+
+            from lfm_quant_tpu.train.loop import resolve_panel
+            from lfm_quant_tpu.train.walkforward import run_walkforward
+
+            panel = resolve_panel(cfg.data)
+            start = args.wf_start or int(
+                panel.dates[int(panel.n_months * 0.6)])
+            wf_dir = os.path.join(cfg.out_dir, cfg.name, "wf")
+            _, _, summary = run_walkforward(
+                cfg, panel, start=start, step_months=args.walk_forward,
+                val_months=args.wf_val_months, n_folds=args.wf_folds,
+                out_dir=wf_dir, echo=args.echo, resume=args.resume)
+            summary["run_dir"] = wf_dir
+        elif cfg.n_seeds > 1:
             from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
             summary, _, _ = run_ensemble_experiment(
                 cfg, echo=args.echo, resume=args.resume)
