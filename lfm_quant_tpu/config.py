@@ -40,6 +40,9 @@ class DataConfig:
     # (ops/pallas_gather.py) on TPU when the step is un-partitioned, else
     # the XLA row gather (data/windows.py).
     gather_impl: str = "auto"  # auto | xla | pallas
+    # Derived feature columns appended at load (data/features.py):
+    # e.g. ("mom_12_1", "vol_12", "rev_1", "chg_<col>_<k>").
+    derived_features: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass

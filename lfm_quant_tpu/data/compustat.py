@@ -275,23 +275,17 @@ def load_compustat_csv(
 
     valid = ~np.isnan(feats).any(axis=2)
 
-    # Per-month winsorize + z-score over the valid cross-section.
+    # Per-month winsorize + z-score over the valid cross-section — the
+    # shared recipe (data/features.py winsorize_zscore) so derived
+    # columns standardize identically.
+    from lfm_quant_tpu.data.features import winsorize_zscore
+
     for j in range(t):
         rowsel = valid[:, j]
         if rowsel.sum() < min_cross_section:
             valid[:, j] = False
             continue
-        x = feats[rowsel, j, :]
-        if winsor is not None:
-            # Order-statistic quantiles (no interpolation): an interpolated
-            # 99th pct is itself dragged by a single extreme outlier.
-            lo = np.nanquantile(x, winsor[0], axis=0, method="higher")
-            hi = np.nanquantile(x, winsor[1], axis=0, method="lower")
-            x = np.clip(x, lo, hi)
-        mu = x.mean(axis=0)
-        sd = x.std(axis=0)
-        sd = np.where(sd < 1e-8, 1.0, sd)
-        feats[rowsel, j, :] = (x - mu) / sd
+        feats[rowsel, j, :] = winsorize_zscore(feats[rowsel, j, :], winsor)
 
     feats = np.where(valid[..., None], feats, 0.0).astype(np.float32)
 

@@ -648,19 +648,27 @@ class Trainer:
 
 def resolve_panel(d) -> Panel:
     """DataConfig → Panel: saved .npz dir, CSV/parquet (Compustat-style
-    long format via data/compustat.py), or the synthetic generator."""
+    long format via data/compustat.py), or the synthetic generator —
+    plus any configured derived feature columns (data/features.py)."""
     from lfm_quant_tpu.data.panel import load_panel, synthetic_panel
 
     if d.panel_path:
         if d.panel_path.endswith((".csv", ".parquet", ".pq")):
             from lfm_quant_tpu.data.compustat import load_compustat_csv
 
-            return load_compustat_csv(d.panel_path, horizon=d.horizon)
-        return load_panel(d.panel_path)
-    return synthetic_panel(
-        n_firms=d.n_firms, n_months=d.n_months, n_features=d.n_features,
-        start_yyyymm=d.start_yyyymm, horizon=d.horizon, seed=d.panel_seed,
-    )
+            panel = load_compustat_csv(d.panel_path, horizon=d.horizon)
+        else:
+            panel = load_panel(d.panel_path)
+    else:
+        panel = synthetic_panel(
+            n_firms=d.n_firms, n_months=d.n_months, n_features=d.n_features,
+            start_yyyymm=d.start_yyyymm, horizon=d.horizon, seed=d.panel_seed,
+        )
+    if getattr(d, "derived_features", ()):
+        from lfm_quant_tpu.data.features import add_derived_features
+
+        panel = add_derived_features(panel, d.derived_features)
+    return panel
 
 
 def run_experiment(cfg: RunConfig, panel: Optional[Panel] = None,

@@ -1,0 +1,145 @@
+"""Derived feature engineering (data/features.py): hand-computed math,
+no-lookahead guarantee, standardization, and trainer integration."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.features import (
+    _raw_column,
+    add_derived_features,
+    standardize_column,
+)
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=60, n_months=120, n_features=5, seed=9)
+
+
+def _manual_mom(panel, i, t, L, S):
+    """Sum of log1p over returns earned in months (t-L, t-S]."""
+    rv = panel.ret_valid if panel.ret_valid is not None else panel.valid
+    total = 0.0
+    for u in range(t - L + 1, t - S + 1):
+        if u - 1 < 0 or not rv[i, u - 1]:
+            return np.nan
+        total += np.log1p(panel.returns[i, u - 1])
+    return total
+
+
+def test_momentum_matches_manual(panel):
+    raw = _raw_column(panel, "mom_12_1")
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        i = int(rng.integers(0, panel.n_firms))
+        t = int(rng.integers(12, panel.n_months))
+        want = _manual_mom(panel, i, t, 12, 1)
+        got = raw[i, t]
+        if np.isnan(want):
+            assert np.isnan(got), (i, t)
+        else:
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_vol_and_rev_match_manual(panel):
+    vol = _raw_column(panel, "vol_6")
+    rev = _raw_column(panel, "rev_2")
+    rv = panel.ret_valid if panel.ret_valid is not None else panel.valid
+    i, t = 3, 40
+    win = [np.log1p(panel.returns[i, u - 1]) for u in range(t - 5, t + 1)]
+    if all(rv[i, u - 1] for u in range(t - 5, t + 1)):
+        np.testing.assert_allclose(vol[i, t], np.std(win), rtol=1e-8)
+    win2 = [np.log1p(panel.returns[i, u - 1]) for u in range(t - 1, t + 1)]
+    if all(rv[i, u - 1] for u in range(t - 1, t + 1)):
+        np.testing.assert_allclose(rev[i, t], -sum(win2), rtol=1e-8)
+
+
+def test_chg_matches_manual(panel):
+    name = panel.feature_names[0]
+    raw = _raw_column(panel, f"chg_{name}_3")
+    i, t = 7, 50
+    if panel.valid[i, t] and panel.valid[i, t - 3]:
+        want = panel.features[i, t, 0] - panel.features[i, t - 3, 0]
+        np.testing.assert_allclose(raw[i, t], want, rtol=1e-6)
+
+
+def test_no_lookahead(panel):
+    """Derived values at anchors <= t must not move when the future
+    (returns earned after month t) changes."""
+    t_cut = 60
+    raw_before = {s: _raw_column(panel, s)
+                  for s in ("mom_12_1", "vol_6", "rev_1")}
+    mutated = dataclasses.replace(
+        panel, returns=panel.returns.copy())
+    # returns[:, u] is the forward return earned over (u, u+1] — indexes
+    # info revealed AFTER month u. Mutating u >= t_cut must leave anchors
+    # <= t_cut untouched.
+    mutated.returns[:, t_cut:] = 9.9
+    for s, before in raw_before.items():
+        after = _raw_column(mutated, s)
+        np.testing.assert_array_equal(before[:, :t_cut + 1],
+                                      after[:, :t_cut + 1])
+
+
+def test_standardize_column(panel):
+    raw = _raw_column(panel, "mom_12_1")
+    col = standardize_column(raw, panel.valid, min_cross_section=8)
+    avail = np.isfinite(raw) & panel.valid
+    for j in (30, 60, 100):
+        sel = avail[:, j]
+        if sel.sum() >= 8:
+            assert abs(col[sel, j].mean()) < 1e-5
+            assert 0.5 < col[sel, j].std() < 1.5  # winsorized → not exactly 1
+    assert (col[~avail] == 0).all()
+
+
+def test_add_derived_features(panel):
+    specs = ["mom_12_1", "vol_6", f"chg_{panel.feature_names[0]}_3"]
+    out = add_derived_features(panel, specs)
+    assert out.n_features == panel.n_features + 3
+    assert list(out.feature_names)[-3:] == specs
+    np.testing.assert_array_equal(out.features[..., :panel.n_features],
+                                  panel.features)
+    # Original untouched; other arrays shared semantics intact.
+    assert panel.n_features == 5
+    np.testing.assert_array_equal(out.valid, panel.valid)
+
+
+def test_bad_specs_raise(panel):
+    with pytest.raises(ValueError, match="unknown feature spec"):
+        _raw_column(panel, "bogus_3")
+    with pytest.raises(ValueError, match="lookback > skip"):
+        _raw_column(panel, "mom_1_1")
+    with pytest.raises(ValueError, match="no feature column"):
+        _raw_column(panel, "chg_nope_3")
+
+
+def test_trainer_integration(tmp_path):
+    from lfm_quant_tpu.config import (
+        DataConfig,
+        ModelConfig,
+        OptimConfig,
+        RunConfig,
+    )
+    from lfm_quant_tpu.data import PanelSplits
+    from lfm_quant_tpu.train import Trainer
+    from lfm_quant_tpu.train.loop import resolve_panel
+
+    cfg = RunConfig(
+        name="feat",
+        data=DataConfig(n_firms=80, n_months=150, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=24,
+                        derived_features=("mom_12_1", "rev_1")),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=1, warmup_steps=2, loss="mse"),
+        out_dir=str(tmp_path),
+    )
+    panel = resolve_panel(cfg.data)
+    assert panel.n_features == 7  # 5 base + 2 derived
+    splits = PanelSplits.by_date(panel, 197910, 198101)
+    trainer = Trainer(cfg, splits)
+    summary = trainer.fit()
+    assert np.isfinite(summary["best_val_ic"])
