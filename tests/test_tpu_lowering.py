@@ -1,0 +1,155 @@
+"""TPU Mosaic lowering validation WITHOUT a TPU.
+
+``jax.export(..., platforms=["tpu"])`` runs the real Mosaic lowering
+pipeline on any host, enforcing the TPU block-shape/DMA constraints that
+interpret-mode execution skips — exactly the class of bug (illegal
+squeezed blocks from vmap batching) that once passed 184 CPU tests and
+crashed on the chip. These tests cross-lower the Pallas kernels with
+``interpret=False`` through the same transform stacks the trainers use —
+jit(vmap(grad(...))) for the single-chip ensemble AND shard_map over a
+mesh (where each kernel sees the PER-SHARD batch) — without executing
+anything.
+
+Scope caveat: export catches lowering/verifier failures only.
+Compile-stage resource failures (a block past the ~16 MB VMEM budget,
+layout-inference issues) still need a real chip — see README "kernel
+caveat".
+
+Only shapes/dtypes matter to lowering, so arguments are
+``jax.ShapeDtypeStruct``s — nothing is allocated.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import export
+from jax.sharding import PartitionSpec as P
+
+from lfm_quant_tpu.ops.pallas_gather import gather_windows_pallas
+from lfm_quant_tpu.ops.pallas_rnn import rnn_scan, rnn_scan_fused
+
+CELLS = ["lstm", "gru"]
+GATES = {"lstm": 4, "gru": 3}
+
+
+def _sds(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _lower_tpu(fn, *args):
+    exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    assert exp.platforms == ("tpu",)
+
+
+@pytest.mark.parametrize("cell", CELLS)
+def test_rnn_scan_vmap_grad_lowers(cell):
+    """The ensemble step's stack — jit(vmap(grad)) — must produce a legal
+    Mosaic lowering via the custom_vmap seed-grid dispatch."""
+    S, B, T, H = 2, 16, 4, 128
+    G = GATES[cell] * H
+
+    def loss(xw, wh, m):
+        return (rnn_scan(cell, xw, wh, m, interpret=False) ** 2).sum()
+
+    _lower_tpu(jax.vmap(jax.grad(loss, argnums=(0, 1))),
+               _sds((S, B, T, G)), _sds((S, H, G)), _sds((S, B, T)))
+
+
+@pytest.mark.parametrize("cell", CELLS)
+def test_rnn_scan_fused_vmap_grad_lowers(cell):
+    S, B, T, H = 2, 16, 4, 128
+    G = GATES[cell] * H
+
+    def loss(hin, wx, b, wh, m):
+        return (rnn_scan_fused(cell, hin, wx, b, wh, m,
+                               interpret=False) ** 2).sum()
+
+    _lower_tpu(jax.vmap(jax.grad(loss, argnums=(1, 2, 3))),
+               _sds((S, B, T, H)), _sds((S, H, G)), _sds((S, G)),
+               _sds((S, H, G)), _sds((S, B, T)))
+
+
+def test_rnn_scan_shared_weights_lowers():
+    """Eval-style batching: shared data, per-seed weights — the pinned
+    index maps for size-1 seed axes must lower too."""
+    S, B, T, H = 2, 16, 4, 128
+    G = 4 * H
+    xw = jnp.zeros((B, T, G))
+    m = jnp.zeros((B, T))
+
+    _lower_tpu(jax.vmap(
+        lambda w: rnn_scan("lstm", xw, w, m, interpret=False)),
+        _sds((S, H, G)))
+
+
+def test_gather_vmap_lowers():
+    """The seed-folded gather (one kernel, S·D date grid rows)."""
+    N, T, Fp, W = 32, 64, 128, 24
+    xm = jnp.zeros((N, T, Fp))
+    S, D, Bf = 2, 3, 8
+
+    _lower_tpu(jax.vmap(
+        lambda a, b: gather_windows_pallas(xm, a, b, window=W,
+                                           interpret=False)),
+        _sds((S, D, Bf), jnp.int32), _sds((S, D), jnp.int32))
+
+
+@pytest.mark.parametrize("impl", ["plain", "fused"])
+def test_shard_map_per_shard_geometry_lowers(impl):
+    """The trainers wrap the kernels in shard_map over the data mesh, so
+    each kernel sees B / n_shards rows — cross-lower THAT stack on an
+    8-way mesh at the c2 global batch (per-shard B = 256), grad included.
+    (Requires the 8-device CPU platform from conftest.py.)"""
+    mesh = jax.make_mesh((8,), ("data",))
+    B, T, H = 2048, 8, 128
+    G = 4 * H
+
+    if impl == "plain":
+        def loss(xw, wh, m):
+            return (rnn_scan("lstm", xw, wh, m,
+                             interpret=False) ** 2).sum()
+
+        f = jax.shard_map(jax.grad(loss, argnums=(0, 1)), mesh=mesh,
+                          in_specs=(P("data"), P(), P("data")),
+                          out_specs=(P("data"), P()), check_vma=False)
+        args = (_sds((B, T, G)), _sds((H, G)), _sds((B, T)))
+    else:
+        def loss(hin, wx, b, wh, m):
+            return (rnn_scan_fused("lstm", hin, wx, b, wh, m,
+                                   interpret=False) ** 2).sum()
+
+        f = jax.shard_map(jax.grad(loss, argnums=(1, 2, 3)), mesh=mesh,
+                          in_specs=(P("data"), P(), P(), P(), P("data")),
+                          out_specs=(P(), P(), P()), check_vma=False)
+        args = (_sds((B, T, H)), _sds((H, G)), _sds((G,)), _sds((H, G)),
+                _sds((B, T)))
+    _lower_tpu(f, *args)
+
+
+@pytest.mark.parametrize("B", [64, 128, 256])
+def test_per_shard_batch_sizes_lower(B):
+    """Block legality across the per-shard batch sizes a v5e-8/-16/-64
+    mesh produces from the ladder's global batches."""
+    T, H = 4, 128
+    G = 4 * H
+
+    def loss(xw, wh, m):
+        return (rnn_scan("lstm", xw, wh, m, interpret=False) ** 2).sum()
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1)),
+               _sds((B, T, G)), _sds((H, G)), _sds((B, T)))
+
+
+def test_bf16_c2_geometry_lowers():
+    """One full-width bf16 lowering at the real config-2 kernel geometry
+    (B = 2048, T = 60, H = 128) — the shapes the bench runs."""
+    B, T, H = 2048, 60, 128
+    G = 4 * H
+
+    def loss(xw, wh, m):
+        return (rnn_scan("lstm", xw, wh, m,
+                         interpret=False).astype(jnp.float32) ** 2).sum()
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1)),
+               _sds((B, T, G), jnp.bfloat16), _sds((H, G), jnp.bfloat16),
+               _sds((B, T), jnp.bfloat16))
