@@ -53,12 +53,13 @@ class ModelConfig:
     kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     bf16: bool = False
     heteroscedastic: bool = False
-    # RNN recurrence implementation: "auto" picks the fused Pallas kernel
-    # (ops/pallas_rnn.py) on TPU when no GSPMD mesh is in play (a
-    # pallas_call is opaque to the partitioner), else the XLA lax.scan.
-    # auto | xla | pallas | pallas_fused ("auto" = pallas on TPU, xla
-    # elsewhere; pallas_fused additionally computes the gate input
-    # projection in-kernel — opt-in until its on-chip numbers land).
+    # RNN recurrence implementation: "auto" picks the fused-projection
+    # Pallas kernel (ops/pallas_rnn.py rnn_scan_fused) on TPU — measured
+    # on chip at c2 geometry: 40.4M fm/s vs 34.8M ("pallas") vs 19.3M
+    # ("xla"), and +31% ensemble throughput — else the XLA lax.scan.
+    # Under a mesh the step runs inside shard_map where each shard is
+    # locally un-partitioned, so the kernel stays legal (train/loop.py).
+    # auto | xla | pallas | pallas_fused.
     scan_impl: str = "auto"
 
 
@@ -191,7 +192,8 @@ def model_kwargs(cfg: RunConfig, mesh=None,
         if "scan_impl" not in kw:
             impl = cfg.model.scan_impl
             if impl == "auto":
-                impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+                impl = ("pallas_fused" if jax.default_backend() == "tpu"
+                        else "xla")
             kw["scan_impl"] = impl
         if force_xla_scan:
             kw["scan_impl"] = "xla"
