@@ -49,7 +49,7 @@ class DataConfig:
 class ModelConfig:
     """Model selection + hyperparameters (L3)."""
 
-    kind: str = "mlp"  # mlp | lstm | gru | transformer
+    kind: str = "mlp"  # mlp | lstm | gru | transformer | lru
     kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     bf16: bool = False
     heteroscedastic: bool = False

@@ -1,4 +1,4 @@
-"""Factor models (L3): MLP, LSTM, GRU, transformer encoder.
+"""Factor models (L3): MLP, LSTM, GRU, transformer encoder, LRU.
 
 Parity targets: the reference's ``mlp_model`` and ``rnn_model`` (LSTM/GRU)
 plus the transformer-encoder ladder config (SURVEY.md §3; BASELINE.json:5,10).
@@ -17,6 +17,7 @@ output; masking holds carried state through invalid months so ragged
 histories never contaminate the forecast.
 """
 
+from lfm_quant_tpu.models.lru import LRUModel
 from lfm_quant_tpu.models.mlp import MLPModel
 from lfm_quant_tpu.models.rnn import GRUModel, LSTMModel, RNNModel
 from lfm_quant_tpu.models.transformer import TransformerModel
@@ -26,6 +27,7 @@ MODEL_REGISTRY = {
     "lstm": LSTMModel,
     "gru": GRUModel,
     "transformer": TransformerModel,
+    "lru": LRUModel,
 }
 
 
@@ -41,6 +43,7 @@ def build_model(kind: str, **kwargs):
 
 
 __all__ = [
+    "LRUModel",
     "MLPModel",
     "LSTMModel",
     "GRUModel",

@@ -1,0 +1,166 @@
+"""Linear Recurrent Unit factor model — the time-PARALLEL recurrence.
+
+Beyond-reference model family (the reference ships MLP/LSTM/GRU only —
+SURVEY.md §3), motivated by the retrieved throughput literature
+(PAPERS.md: "Parallelizing Linear Recurrent Neural Nets Over Sequence
+Length", "Parallelizing Legendre Memory Unit Training"): a *linear*
+diagonal recurrence has an associative step, so the whole T-step history
+folds in O(log T) depth via ``lax.associative_scan`` instead of the
+LSTM/GRU's irreducibly serial T-step chain. On TPU that turns the
+recurrence from the latency-bound tail of the step into a few elementwise
+VPU passes, and every remaining FLOP is a big ``[B·T, ·]`` GEMM the MXU
+tiles perfectly — no Pallas kernel needed, XLA alone reaches high MFU.
+
+The cell is the LRU of the linear-RNN line of work: per layer a complex
+diagonal state ``h_t = λ ⊙ h_{t-1} + γ ⊙ (B x_t)`` with
+``λ = exp(-exp(ν) + i·exp(θ))`` (stable by construction: |λ| < 1),
+input normalization ``γ = sqrt(1 - |λ|²)``, readout
+``y_t = Re(C h_t) + d ⊙ x_t``, GELU + residual + LayerNorm between
+layers. Complex arithmetic is carried as explicit (re, im) pairs — TPUs
+have no native complex type, and the pairs keep every array bf16/f32.
+
+Masking matches the RNN contract exactly (invalid months HOLD state):
+``h_t = a_t ⊙ h_{t-1} + m_t·γ⊙(B x_t)`` with ``a_t = m_t·λ + (1-m_t)``
+— still a first-order linear recurrence, so the same associative combine
+``(a₂,b₂)∘(a₁,b₁) = (a₁a₂, a₂b₁ + b₂)`` applies and the scan stays
+parallel. The last step's state is therefore the state at the last
+*valid* month, and the readout mirrors models/rnn.py (anchor-last
+windows, ``z = y[..., -1, :]``).
+
+Numerics: the scan runs in f32 (elementwise — VPU-cheap) regardless of
+compute dtype; the B/C projections and head run in the model dtype
+(bf16 on TPU). Params are fp32 throughout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from lfm_quant_tpu.models.heads import ForecastHead
+
+
+def _linear_scan(a_re, a_im, b_re, b_im):
+    """Masked linear recurrence via associative_scan over the time axis.
+
+    All inputs [..., T, N] f32. Returns (h_re, h_im) with
+    ``h_t = a_t·h_{t-1} + b_t`` (h_0 = 0), computed in O(log T) depth.
+    """
+
+    def combine(x, y):
+        xar, xai, xbr, xbi = x
+        yar, yai, ybr, ybi = y
+        # a = xa·ya (complex); b = ya·xb + yb
+        ar = xar * yar - xai * yai
+        ai = xar * yai + xai * yar
+        br = yar * xbr - yai * xbi + ybr
+        bi = yar * xbi + yai * xbr + ybi
+        return ar, ai, br, bi
+
+    _, _, h_re, h_im = jax.lax.associative_scan(
+        combine, (a_re, a_im, b_re, b_im), axis=-2)
+    return h_re, h_im
+
+
+class LRULayer(nn.Module):
+    """One LRU mixing layer: x [..., T, H] → y [..., T, H] (same width)."""
+
+    hidden: int           # model width H (input/output)
+    state_dim: int = 128  # complex state size N
+    r_min: float = 0.9    # |λ| init ring (long-memory end near 1)
+    r_max: float = 0.999
+    max_phase: float = math.pi / 2  # θ init range — 60-step windows
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, m):
+        N = self.state_dim
+        compute = self.dtype or jnp.float32
+
+        def nu_init(key, shape, _=None):
+            u = jax.random.uniform(key, shape)
+            mag2 = self.r_min ** 2 + u * (self.r_max ** 2 - self.r_min ** 2)
+            return jnp.log(-0.5 * jnp.log(mag2)).astype(jnp.float32)
+
+        def theta_init(key, shape, _=None):
+            u = jax.random.uniform(key, shape)
+            return jnp.log(self.max_phase * u + 1e-4).astype(jnp.float32)
+
+        nu_log = self.param("nu_log", nu_init, (N,))
+        theta_log = self.param("theta_log", theta_init, (N,))
+        mag = jnp.exp(-jnp.exp(nu_log))               # |λ| in (0, 1)
+        phase = jnp.exp(theta_log)
+        lam_re = mag * jnp.cos(phase)
+        lam_im = mag * jnp.sin(phase)
+        gamma = jnp.sqrt(jnp.maximum(1.0 - mag ** 2, 1e-6))
+
+        # Input projection Bx (complex, MXU): ONE H→2N GEMM in bf16,
+        # split into (re, im) — half the dispatches of separate re/im
+        # Denses, identical parameterization (the halves concatenate).
+        bx = nn.Dense(2 * N, use_bias=False, dtype=compute, name="b")(x)
+        bx_re, bx_im = jnp.split(bx, 2, axis=-1)
+
+        # Per-step recurrence coefficients with mask-holds-state blended
+        # in: a_t = m·λ + (1-m); b_t = m·γ⊙Bx_t. f32 for the scan.
+        keep = m[..., None].astype(jnp.float32)       # [..., T, 1]
+        a_re = keep * lam_re + (1.0 - keep)
+        a_im = keep * lam_im
+        b_re = keep * gamma * bx_re.astype(jnp.float32)
+        b_im = keep * gamma * bx_im.astype(jnp.float32)
+        h_re, h_im = _linear_scan(a_re, a_im, b_re, b_im)
+
+        # Readout y = Re(C h) + d ⊙ x as ONE 2N→H GEMM over the
+        # concatenated (re, im) state — the -Im(C) sign folds into the
+        # learned kernel, so the parameterization is unchanged.
+        hcat = jnp.concatenate(
+            [h_re.astype(compute), h_im.astype(compute)], axis=-1)
+        y = nn.Dense(self.hidden, use_bias=True, dtype=compute,
+                     name="c")(hcat)
+        d = self.param("d_skip", nn.initializers.ones_init(),
+                       (self.hidden,), jnp.float32)
+        return y + d.astype(compute) * x
+
+
+class LRUModel(nn.Module):
+    """Stacked LRU blocks over the lookback window → forecast head.
+
+    Same calling convention as every model in the registry:
+    ``apply({'params': p}, x [B, W, F], m [B, W]) → [B] fp32`` (or
+    (mean, log_var) when ``heteroscedastic``). Depth-wise each block is
+    pre-norm: ``x + GELU(LRU(LN(x)))`` — the residual keeps the anchor
+    month's information intact through depth.
+    """
+
+    hidden: int = 128
+    state_dim: int = 128
+    layers: int = 2
+    head_hidden: Sequence[int] = ()
+    heteroscedastic: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, m, deterministic: bool = True):
+        del deterministic  # no dropout in this trunk
+        compute = self.dtype or jnp.float32
+        h = nn.Dense(self.hidden, dtype=self.dtype, name="embed")(
+            x.astype(compute))
+        for layer in range(self.layers):
+            z = nn.LayerNorm(dtype=self.dtype, name=f"norm_{layer}")(h)
+            z = LRULayer(
+                hidden=self.hidden, state_dim=self.state_dim,
+                dtype=self.dtype, name=f"lru_{layer}",
+            )(z, m)
+            h = h + nn.gelu(z)
+        # Anchor-last windows + mask-holds-state: the last step carries
+        # the last valid month's state (models/rnn.py readout parity).
+        z = h[..., -1, :]
+        return ForecastHead(
+            hidden=self.head_hidden,
+            heteroscedastic=self.heteroscedastic,
+            dtype=self.dtype,
+            name="head",
+        )(z)

@@ -55,6 +55,15 @@ def _transformer_train_flops_per_fm(dim: int, depth: int, window: int,
     return 3.0 * fwd
 
 
+def _lru_train_flops_per_fm(hidden: int, state: int, layers: int,
+                            features: int) -> float:
+    """Per firm-month: embed (F→H) + per layer the complex B (2× H→N) and
+    C (2× N→H) GEMMs; the associative scan is elementwise (excluded, like
+    the RNN gate math)."""
+    fwd = 2 * features * hidden + layers * 4 * 2 * hidden * state
+    return 3.0 * fwd
+
+
 def _flops_per_fm(cfg) -> float:
     kind, kw, d = cfg.model.kind, cfg.model.kwargs, cfg.data
     if kind == "mlp":
@@ -63,6 +72,10 @@ def _flops_per_fm(cfg) -> float:
     if kind in ("lstm", "gru"):
         return _rnn_train_flops_per_fm(kind, kw.get("hidden", 128),
                                        d.n_features)
+    if kind == "lru":
+        return _lru_train_flops_per_fm(kw.get("hidden", 128),
+                                       kw.get("state_dim", 128),
+                                       kw.get("layers", 2), d.n_features)
     return _transformer_train_flops_per_fm(kw.get("dim", 64),
                                            kw.get("depth", 2), d.window,
                                            d.n_features)
@@ -85,23 +98,68 @@ def _bench_panel(cfg):
     return PanelSplits.by_date(panel, train_end, val_end)
 
 
+def _log(msg: str) -> None:
+    """Stage progress on stderr: a hung run (remote compile, tunnel) then
+    shows exactly which config/stage it died in instead of going silent."""
+    print(f"[bench_ladder] {msg}", file=sys.stderr, flush=True)
+
+
+def _overrides(cfg):
+    """Env overrides mirroring bench.py's LFM_BENCH_SCAN_IMPL:
+    LFM_BENCH_GATHER_IMPL=auto|xla|pallas reroutes the window gather —
+    the bisection hook for on-chip gather issues."""
+    import bench as _bench
+
+    if cfg.model.kind in ("lstm", "gru"):  # scan_impl is an RNN-only knob
+        cfg = _bench._scan_impl_override(cfg)
+    gi = os.environ.get("LFM_BENCH_GATHER_IMPL")
+    if gi:
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data, gather_impl=gi))
+    return cfg
+
+
+def _resolve_cfg(name: str):
+    """Ladder preset, or "lru": the c2 geometry with the time-parallel
+    LRU model swapped in — the apples-to-apples fm/s comparison against
+    the LSTM's serial recurrence (models/lru.py)."""
+    import dataclasses as _dc
+
+    from lfm_quant_tpu.config import ModelConfig, get_preset
+
+    if name == "lru":
+        base = get_preset("c2")
+        return _dc.replace(
+            base, name="lru_c2_geometry",
+            model=ModelConfig(kind="lru",
+                              kwargs={"hidden": 128, "state_dim": 128},
+                              bf16=True))
+    return get_preset(name)
+
+
 def bench_config(name: str) -> dict:
-    from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.train import Trainer
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
 
-    cfg = get_preset(name)
+    cfg = _overrides(_resolve_cfg(name))
+    _log(f"{name}: building panel")
     splits = _bench_panel(cfg)
     if cfg.n_seeds > 1:
         n_seeds = int(os.environ.get("LFM_BENCH_SEEDS", "16"))
         cfg = dataclasses.replace(cfg, n_seeds=n_seeds)
+        _log(f"{name}: building EnsembleTrainer ({n_seeds} seeds)")
         trainer = EnsembleTrainer(cfg, splits)
+        _log(f"{name}: measuring (compile on first dispatch)")
         value = measure_ensemble_trainer(
             trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10")))
     else:
+        _log(f"{name}: building Trainer")
         trainer = Trainer(cfg, splits)
+        _log(f"{name}: gather={trainer._gather_impl}; measuring "
+             "(compile on first dispatch)")
         value = measure_trainer(
             trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "30")))
+    _log(f"{name}: done")
     flops = _flops_per_fm(cfg)
     return {
         "metric": f"train_throughput_{name}",

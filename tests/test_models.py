@@ -8,7 +8,7 @@ import pytest
 from lfm_quant_tpu.models import build_model
 
 B, W, F = 8, 24, 6
-KINDS = ["mlp", "lstm", "gru", "transformer"]
+KINDS = ["mlp", "lstm", "gru", "transformer", "lru"]
 
 
 def make_batch(seed=0, all_valid=False):
@@ -66,7 +66,7 @@ def test_masked_steps_do_not_affect_output(kind):
     np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), atol=1e-5)
 
 
-@pytest.mark.parametrize("kind", ["lstm", "gru"])
+@pytest.mark.parametrize("kind", ["lstm", "gru", "lru"])
 def test_rnn_ignores_leading_padding_entirely(kind):
     """A left-padded short history must equal the same history without pad."""
     rng = np.random.default_rng(3)
@@ -142,12 +142,55 @@ def test_mlp_anchor_only_mode():
     assert y.shape == (B,)
 
 
-def test_rnn_uses_time_structure():
-    """Reversing the window order must change an RNN forecast (the planted
-    trend term in the synthetic panel is only learnable this way)."""
+@pytest.mark.parametrize("kind", ["lstm", "lru"])
+def test_rnn_uses_time_structure(kind):
+    """Reversing the window order must change a recurrent forecast (the
+    planted trend term in the synthetic panel is only learnable this way)."""
     x, m = make_batch(all_valid=True)
-    model = build_model("lstm")
+    model = build_model(kind)
     params = model.init(jax.random.key(0), x, m)
     y = model.apply(params, x, m)
     y_rev = model.apply(params, x[:, ::-1], m)
     assert not np.allclose(np.asarray(y), np.asarray(y_rev), atol=1e-4)
+
+
+def test_lru_linear_scan_matches_serial_reference():
+    """The associative-scan recurrence must equal the serial lax.scan
+    h_t = a_t·h_{t-1} + b_t (complex, carried as re/im pairs)."""
+    from lfm_quant_tpu.models.lru import _linear_scan
+
+    rng = np.random.default_rng(7)
+    Bn, T, N = 4, 31, 8
+    ar, ai, br, bi = (
+        jnp.asarray(rng.standard_normal((Bn, T, N)).astype(np.float32) * 0.5)
+        for _ in range(4))
+    h_re, h_im = _linear_scan(ar, ai, br, bi)
+
+    def step(carry, inp):
+        hr, hi = carry
+        a_r, a_i, b_r, b_i = inp
+        nr = a_r * hr - a_i * hi + b_r
+        ni = a_r * hi + a_i * hr + b_i
+        return (nr, ni), (nr, ni)
+
+    _, (sr, si) = jax.lax.scan(
+        step, (jnp.zeros((Bn, N)), jnp.zeros((Bn, N))),
+        tuple(jnp.swapaxes(v, 0, 1) for v in (ar, ai, br, bi)))
+    np.testing.assert_allclose(np.asarray(h_re),
+                               np.asarray(jnp.swapaxes(sr, 0, 1)),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(h_im),
+                               np.asarray(jnp.swapaxes(si, 0, 1)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_lru_state_magnitude_stable():
+    """|λ| < 1 by construction: an all-valid constant input must not blow
+    up over a window 10× the init's implied memory horizon."""
+    x = jnp.ones((2, 240, F), jnp.float32)
+    m = jnp.ones((2, 240), bool)
+    model = build_model("lru", hidden=16, state_dim=16)
+    params = model.init(jax.random.key(0), x, m)
+    y = model.apply(params, x, m)
+    assert bool(jnp.isfinite(y).all())
+    assert float(jnp.abs(y).max()) < 1e3

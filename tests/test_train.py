@@ -173,3 +173,18 @@ def test_bench_scan_impl_override(monkeypatch):
     monkeypatch.delenv("LFM_BENCH_SCAN_IMPL")
     cfg = bench._scan_impl_override(get_preset("c2"))
     assert "scan_impl" not in cfg.model.kwargs
+
+
+def test_lru_trains_end_to_end(panel, tmp_path):
+    """The time-parallel LRU family plugs into the same train stack and
+    learns the planted signal (val IC clears noise)."""
+    cfg = tiny_cfg(
+        name="t_lru",
+        model=ModelConfig(kind="lru",
+                          kwargs={"hidden": 32, "state_dim": 32}),
+        out_dir=str(tmp_path),
+    )
+    summary, trainer, _ = run_experiment(cfg, panel=panel)
+    assert summary["history"][-1]["train_loss"] < summary["history"][0][
+        "train_loss"]
+    assert summary["best_val_ic"] > 0.05
