@@ -44,26 +44,84 @@ import jax.numpy as jnp
 from lfm_quant_tpu.models.heads import ForecastHead
 
 
+def _combine(x, y):
+    """First-order-recurrence composition: apply x (earlier) then y
+    (later). Elements are (a_re, a_im, b_re, b_im) meaning h ↦ a·h + b;
+    the composition is a = xa·ya, b = ya·xb + yb (complex arithmetic on
+    explicit re/im pairs)."""
+    xar, xai, xbr, xbi = x
+    yar, yai, ybr, ybi = y
+    ar = xar * yar - xai * yai
+    ai = xar * yai + xai * yar
+    br = yar * xbr - yai * xbi + ybr
+    bi = yar * xbi + yai * xbr + ybi
+    return ar, ai, br, bi
+
+
 def _linear_scan(a_re, a_im, b_re, b_im):
     """Masked linear recurrence via associative_scan over the time axis.
 
     All inputs [..., T, N] f32. Returns (h_re, h_im) with
     ``h_t = a_t·h_{t-1} + b_t`` (h_0 = 0), computed in O(log T) depth.
     """
-
-    def combine(x, y):
-        xar, xai, xbr, xbi = x
-        yar, yai, ybr, ybi = y
-        # a = xa·ya (complex); b = ya·xb + yb
-        ar = xar * yar - xai * yai
-        ai = xar * yai + xai * yar
-        br = yar * xbr - yai * xbi + ybr
-        bi = yar * xbi + yai * xbr + ybi
-        return ar, ai, br, bi
-
     _, _, h_re, h_im = jax.lax.associative_scan(
-        combine, (a_re, a_im, b_re, b_im), axis=-2)
+        _combine, (a_re, a_im, b_re, b_im), axis=-2)
     return h_re, h_im
+
+
+def _distributed_linear_scan(a_re, a_im, b_re, b_im, axis: str):
+    """Sequence-parallel linear recurrence — the long-context mode.
+
+    Must run inside ``shard_map`` with the TIME axis of all four inputs
+    sharded over mesh axis ``axis`` (T_local = T / S per shard). Three
+    phases, the classic scan decomposition laid onto the mesh:
+
+    1. local inclusive scan (O(log T_local) depth, no communication);
+    2. ONE ``all_gather`` of each shard's aggregate transform — the
+       (A, B) pair folding its whole local block — S·N numbers per
+       batch row, tiny next to the activations; every shard then folds
+       the exclusive prefix of earlier shards' aggregates in S steps
+       (S = mesh axis size, compile-time constant);
+    3. local correction ``h_t ← h_t + cumA_t ⊙ h_in`` where ``h_in`` is
+       the state entering this shard — elementwise, no communication.
+
+    Contrast with ring attention (parallel/ring.py): no rotation, no
+    O(S) pipeline — the linear recurrence's associativity collapses the
+    cross-shard dependency into one collective.
+    """
+    h_re, h_im = _linear_scan(a_re, a_im, b_re, b_im)
+    # Local cumulative product of a (complex) — needed for the prefix
+    # correction; shares the combine via b = 0.
+    z = jnp.zeros_like(a_re)
+    cA_re, cA_im, _, _ = jax.lax.associative_scan(
+        _combine, (a_re, a_im, z, z), axis=-2)
+
+    S = jax.lax.psum(1, axis)  # static under shard_map
+    if S == 1:
+        return h_re, h_im
+    agg = (cA_re[..., -1, :], cA_im[..., -1, :],
+           h_re[..., -1, :], h_im[..., -1, :])
+    # Gather every shard's aggregate as [S, ...] via one-hot + psum
+    # rather than all_gather: psum is the collective with the cleanest
+    # AD story under shard_map, and the aggregates are S·N scalars per
+    # batch row — the broadcast costs nothing.
+    me = jax.lax.axis_index(axis)
+    onehot = (jnp.arange(S) == me).astype(agg[0].dtype)
+    gathered = tuple(
+        jax.lax.psum(onehot.reshape((S,) + (1,) * v.ndim) * v[None], axis)
+        for v in agg)
+    cur = (jnp.ones_like(agg[0]), jnp.zeros_like(agg[1]),
+           jnp.zeros_like(agg[2]), jnp.zeros_like(agg[3]))
+    prefixes = []
+    for s in range(S):
+        prefixes.append(cur)
+        cur = _combine(cur, tuple(v[s] for v in gathered))
+    stacked = tuple(jnp.stack([p[i] for p in prefixes]) for i in range(4))
+    hin_re = jnp.take(stacked[2], me, axis=0)
+    hin_im = jnp.take(stacked[3], me, axis=0)
+    hin_re, hin_im = hin_re[..., None, :], hin_im[..., None, :]
+    return (h_re + cA_re * hin_re - cA_im * hin_im,
+            h_im + cA_re * hin_im + cA_im * hin_re)
 
 
 class LRULayer(nn.Module):
@@ -75,6 +133,7 @@ class LRULayer(nn.Module):
     r_max: float = 0.999
     max_phase: float = math.pi / 2  # θ init range — 60-step windows
     dtype: Optional[jnp.dtype] = None
+    seq_axis: Optional[str] = None  # mesh axis name for sharded time
 
     @nn.compact
     def __call__(self, x, m):
@@ -111,7 +170,11 @@ class LRULayer(nn.Module):
         a_im = keep * lam_im
         b_re = keep * gamma * bx_re.astype(jnp.float32)
         b_im = keep * gamma * bx_im.astype(jnp.float32)
-        h_re, h_im = _linear_scan(a_re, a_im, b_re, b_im)
+        if self.seq_axis is not None:
+            h_re, h_im = _distributed_linear_scan(
+                a_re, a_im, b_re, b_im, self.seq_axis)
+        else:
+            h_re, h_im = _linear_scan(a_re, a_im, b_re, b_im)
 
         # Readout y = Re(C h) + d ⊙ x as ONE 2N→H GEMM over the
         # concatenated (re, im) state — the -Im(C) sign folds into the
@@ -141,23 +204,47 @@ class LRUModel(nn.Module):
     head_hidden: Sequence[int] = ()
     heteroscedastic: bool = False
     dtype: Optional[jnp.dtype] = None
+    # Sequence-parallel mode: run inside shard_map with the window axis
+    # of (x, m) sharded over this mesh axis (parallel/ring.py
+    # ``sequence_parallel_apply`` — same contract as TransformerModel).
+    # No per-position params, so checkpoints interchange with seq_axis
+    # None.
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, m, deterministic: bool = True):
         del deterministic  # no dropout in this trunk
         compute = self.dtype or jnp.float32
+        # Zero masked-step features: the scan already ignores them, but
+        # the residual stream (embed + d-skip) is position-wise and the
+        # readout reads position -1 — without this, an INVALID anchor
+        # month would leak its (garbage) features into the forecast,
+        # breaking the RNN mask contract ("a function of valid history
+        # only"). With it, an invalid anchor reduces to the held scan
+        # state plus a constant embed-bias offset.
+        x = x * m[..., None].astype(x.dtype)
         h = nn.Dense(self.hidden, dtype=self.dtype, name="embed")(
             x.astype(compute))
         for layer in range(self.layers):
             z = nn.LayerNorm(dtype=self.dtype, name=f"norm_{layer}")(h)
             z = LRULayer(
                 hidden=self.hidden, state_dim=self.state_dim,
-                dtype=self.dtype, name=f"lru_{layer}",
+                dtype=self.dtype, seq_axis=self.seq_axis,
+                name=f"lru_{layer}",
             )(z, m)
             h = h + nn.gelu(z)
         # Anchor-last windows + mask-holds-state: the last step carries
         # the last valid month's state (models/rnn.py readout parity).
         z = h[..., -1, :]
+        if self.seq_axis is not None:
+            # The global last position lives on the LAST shard; replicate
+            # its readout so every shard returns the identical forecast
+            # (sequence_parallel_apply's out_specs=P() contract).
+            n_shard = jax.lax.psum(1, self.seq_axis)
+            me = jax.lax.axis_index(self.seq_axis)
+            z = jax.lax.psum(
+                jnp.where(me == n_shard - 1, z, jnp.zeros_like(z)),
+                self.seq_axis)
         return ForecastHead(
             hidden=self.head_hidden,
             heteroscedastic=self.heteroscedastic,

@@ -194,3 +194,16 @@ def test_lru_state_magnitude_stable():
     y = model.apply(params, x, m)
     assert bool(jnp.isfinite(y).all())
     assert float(jnp.abs(y).max()) < 1e3
+
+
+def test_lru_invalid_anchor_features_do_not_leak():
+    """Even an INVALID anchor month must not leak its features into the
+    forecast (the RNN mask contract: forecast = f(valid history only))."""
+    x, m = make_batch(all_valid=True)
+    m = m.at[:, -1].set(False)  # invalidate every anchor
+    model = build_model("lru")
+    params = model.init(jax.random.key(0), x, m)
+    y0 = model.apply(params, x, m)
+    x2 = x.at[:, -1].add(100.0)  # garbage in the masked anchor
+    y1 = model.apply(params, x2, m)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), atol=1e-5)

@@ -225,3 +225,26 @@ def test_ensemble_shard_map_pallas_matches_xla(lstm_panel, tmp_path):
     for a, c in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_p.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=1e-3, atol=1e-5)
+
+
+def test_dp_training_lru_matches_single_device(panel, tmp_path):
+    """The LRU's associative scan must survive the trainer's shard_map
+    (its AD only composes with shard_map under jit — which the trainer
+    guarantees): 8-way date-sharded steps == single-device steps."""
+    import dataclasses
+
+    splits = PanelSplits.by_date(panel, 197910, 198101)
+    mdl = ModelConfig(kind="lru", kwargs={"hidden": 16, "state_dim": 16})
+    cfg1 = dataclasses.replace(_fit_cfg(panel, 1, tmp_path / "a"), model=mdl)
+    cfg8 = dataclasses.replace(_fit_cfg(panel, 8, tmp_path / "b"), model=mdl)
+    t1, t8 = Trainer(cfg1, splits), Trainer(cfg8, splits)
+    assert t8.mesh is not None and t8.mesh.shape["data"] == 8
+
+    s1, s8 = t1.init_state(), t8.init_state()
+    for b in t1.train_sampler.epoch(0):
+        s1, m1 = t1._jit_step(s1, t1.dev, *t1._batch_args(b, train=True))
+        s8, m8 = t8._jit_step(s8, t8.dev, *t8._batch_args(b, train=True))
+    assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), rel=1e-4)
+    for l1, l8 in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l8),
+                                   rtol=1e-4, atol=1e-5)
