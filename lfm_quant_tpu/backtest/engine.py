@@ -37,19 +37,48 @@ class BacktestReport:
     hit_rate: float          # fraction of months with positive return
     n_months: int
     n_skipped_months: int
+    # Benchmark-relative block (benchmark = equal-weight tradeable
+    # universe, the standard LFM-lineage comparison point):
+    bench_cagr: float
+    excess_cagr: float       # portfolio CAGR − benchmark CAGR
+    ir_ann: float            # annualized IR of (portfolio − benchmark)
+    t_stat: float            # t-stat of the mean monthly portfolio return
     monthly_returns: np.ndarray  # [T_used]
     monthly_ic: np.ndarray       # [T_used]
+    monthly_bench: np.ndarray    # [T_used] universe EW forward return
     dates: np.ndarray            # [T_used] YYYYMM of formation months
+    # Mean forward return per forecast-rank bucket, bottom → top — the
+    # monotonicity evidence (a real signal shows increasing buckets).
+    quantile_profile: np.ndarray  # [profile_buckets]
+
+    def yearly(self) -> dict:
+        """Calendar-year breakdown: {year: {"ret", "bench", "mean_ic",
+        "n_months"}} with returns compounded within the year."""
+        years = np.asarray(self.dates) // 100
+        out = {}
+        for y in np.unique(years):
+            ix = years == y
+            out[int(y)] = {
+                "ret": float(np.prod(1.0 + self.monthly_returns[ix]) - 1.0),
+                "bench": float(np.prod(1.0 + self.monthly_bench[ix]) - 1.0),
+                "mean_ic": float(self.monthly_ic[ix].mean()),
+                "n_months": int(ix.sum()),
+            }
+        return out
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
-        for k in ("monthly_returns", "monthly_ic", "dates"):
+        for k in ("monthly_returns", "monthly_ic", "monthly_bench", "dates",
+                  "quantile_profile"):
             d[k] = np.asarray(d[k]).tolist()
+        d["yearly"] = self.yearly()
         return json.dumps(d, indent=2)
 
     def summary(self) -> str:
         return (
-            f"CAGR {self.cagr:+.2%} | Sharpe {self.sharpe_ann:.2f} | "
+            f"CAGR {self.cagr:+.2%} (bench {self.bench_cagr:+.2%}, excess "
+            f"{self.excess_cagr:+.2%}, IR {self.ir_ann:.2f}) | "
+            f"Sharpe {self.sharpe_ann:.2f} | t {self.t_stat:.1f} | "
             f"IC {self.mean_ic:+.3f} | retIC {self.mean_ret_ic:+.3f} | "
             f"maxDD {self.max_drawdown:.2%} | turnover {self.turnover:.2f} | "
             f"months {self.n_months}"
@@ -104,6 +133,7 @@ def run_backtest(
     periods_per_year: int = 12,
     rf_monthly: float = 0.0,
     costs_bps: float = 0.0,
+    profile_buckets: int = 10,
 ) -> BacktestReport:
     """Monthly-rebalance quantile portfolio simulation.
 
@@ -112,11 +142,16 @@ def run_backtest(
     (equal-weight); with ``long_short`` also short the bottom quantile.
     The position earns the forward 1-month return ``panel.returns[:, t]``.
     ``costs_bps`` charges that many basis points on each month's turnover.
+    The report also carries the equal-weight-universe benchmark
+    (excess CAGR, annualized IR) and a ``profile_buckets``-bucket mean
+    forward return profile over the forecast ranking.
     """
     n, t_len = forecast.shape
     if panel.returns.shape != (n, t_len):
         raise ValueError("forecast and panel shapes disagree")
-    rets, ics, ret_ics, dates, turns = [], [], [], [], []
+    rets, ics, ret_ics, dates, turns, benches = [], [], [], [], [], []
+    profile_sum = np.zeros(profile_buckets, np.float64)
+    profile_cnt = np.zeros(profile_buckets, np.int64)
     prev_long: Optional[set] = None
     skipped = 0
     # tradeable() excludes firms whose forward return is unobserved (e.g.
@@ -142,6 +177,13 @@ def run_backtest(
             port_ret -= costs_bps * 1e-4 * turn
         prev_long = cur
         rets.append(port_ret)
+        benches.append(float(panel.returns[uni, t].mean()))
+        month_rets = panel.returns[uni[order], t]  # sorted by forecast
+        for b, chunk in enumerate(np.array_split(month_rets,
+                                                 profile_buckets)):
+            if chunk.size:  # thin months leave high buckets untouched
+                profile_sum[b] += float(chunk.mean())
+                profile_cnt[b] += 1
         ics.append(_spearman(f, panel.targets[uni, t])
                    if panel.target_valid[uni, t].any() else 0.0)
         ret_ics.append(_spearman(f, panel.returns[uni, t]))
@@ -152,6 +194,7 @@ def run_backtest(
             f"no month had a universe of >= {min_universe} forecastable firms"
         )
     r = np.asarray(rets, np.float64)
+    b = np.asarray(benches, np.float64)
     excess = r - rf_monthly
     growth = np.cumprod(1.0 + r)
     years = len(r) / periods_per_year
@@ -160,6 +203,15 @@ def run_backtest(
     sharpe = float(excess.mean() / vol * np.sqrt(periods_per_year)) if vol > 0 else 0.0
     peak = np.maximum.accumulate(growth)
     max_dd = float(((growth - peak) / peak).min())
+    bench_growth = np.cumprod(1.0 + b)
+    bench_cagr = (float(bench_growth[-1] ** (1.0 / years) - 1.0)
+                  if years > 0 else 0.0)
+    active = r - b
+    a_vol = float(active.std(ddof=1)) if len(r) > 1 else 0.0
+    ir = (float(active.mean() / a_vol * np.sqrt(periods_per_year))
+          if a_vol > 0 else 0.0)
+    t_stat = (float(r.mean() / r.std(ddof=1) * np.sqrt(len(r)))
+              if len(r) > 1 and r.std(ddof=1) > 0 else 0.0)
     return BacktestReport(
         cagr=cagr,
         sharpe_ann=sharpe,
@@ -170,7 +222,14 @@ def run_backtest(
         hit_rate=float((r > 0).mean()),
         n_months=len(r),
         n_skipped_months=skipped,
+        bench_cagr=bench_cagr,
+        excess_cagr=cagr - bench_cagr,
+        ir_ann=ir,
+        t_stat=t_stat,
         monthly_returns=r.astype(np.float32),
         monthly_ic=np.asarray(ics, np.float32),
+        monthly_bench=b.astype(np.float32),
         dates=np.asarray(dates, np.int32),
+        quantile_profile=(profile_sum
+                          / np.maximum(profile_cnt, 1)).astype(np.float32),
     )

@@ -150,12 +150,25 @@ def _ladder() -> Dict[str, RunConfig]:
         n_seeds=64,
         n_data_shards=1,
     )
-    return {c.name: c for c in (c1, c2, c3, c4, c5)}
+    # Beyond-ladder preset: the time-parallel LRU (models/lru.py) at the
+    # c2 geometry — the apples-to-apples throughput/accuracy comparison
+    # against the LSTM's serial recurrence.
+    lru = RunConfig(
+        name="lru_c2_geometry",
+        data=dataclasses.replace(c2.data),
+        model=ModelConfig(kind="lru",
+                          kwargs={"hidden": 128, "state_dim": 128},
+                          bf16=True),
+        optim=OptimConfig(lr=1e-3, epochs=30, loss="mse"),
+    )
+    return {c.name: c for c in (c1, c2, c3, c4, c5, lru)}
 
 
 PRESETS: Dict[str, RunConfig] = _ladder()
-# Short aliases: c1..c5.
-PRESETS.update({f"c{i}": cfg for i, cfg in enumerate(_ladder().values(), 1)})
+# Short aliases derived from the names themselves ("c2_lstm_single" →
+# "c2", "lru_c2_geometry" → "lru") — immune to ladder reordering.
+PRESETS.update({name.split("_")[0]: cfg
+                for name, cfg in _ladder().items()})
 
 
 def get_preset(name: str) -> RunConfig:

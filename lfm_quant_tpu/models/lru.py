@@ -89,12 +89,11 @@ def _distributed_linear_scan(a_re, a_im, b_re, b_im, axis: str):
     O(S) pipeline — the linear recurrence's associativity collapses the
     cross-shard dependency into one collective.
     """
-    h_re, h_im = _linear_scan(a_re, a_im, b_re, b_im)
-    # Local cumulative product of a (complex) — needed for the prefix
-    # correction; shares the combine via b = 0.
-    z = jnp.zeros_like(a_re)
-    cA_re, cA_im, _, _ = jax.lax.associative_scan(
-        _combine, (a_re, a_im, z, z), axis=-2)
+    # ONE scan yields both the running state (b outputs) and the
+    # cumulative complex product of a (a outputs) — the latter drives
+    # the prefix correction below.
+    cA_re, cA_im, h_re, h_im = jax.lax.associative_scan(
+        _combine, (a_re, a_im, b_re, b_im), axis=-2)
 
     S = jax.lax.psum(1, axis)  # static under shard_map
     if S == 1:

@@ -42,6 +42,15 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
 
     ``n_data`` defaults to ``len(devices) // n_seed``. A 1×1 mesh on a
     single device is valid and keeps the code path uniform.
+
+    Topology awareness: when the mesh spans ALL devices, the grid comes
+    from ``mesh_utils`` so the 'data' axis (the only axis with a per-step
+    collective — the gradient psum) lands on physically-adjacent devices
+    and rides ICI. On multi-host runs the communication-FREE 'seed' axis
+    is placed across hosts first (``create_hybrid_device_mesh`` with
+    seeds on the DCN dimension): independent ensemble members are the
+    only traffic crossing DCN — none. Explicit ``devices`` or partial
+    meshes fall back to the given order.
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
@@ -54,7 +63,30 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
         raise ValueError(
             f"mesh {n_seed}x{n_data} needs {need} devices, "
             f"have {len(devices)}")
-    grid = np.asarray(devices[:need]).reshape(n_seed, n_data)
+    grid = None
+    if need == len(jax.devices()) and devices == list(jax.devices()):
+        try:
+            from jax.experimental import mesh_utils
+
+            n_proc = jax.process_count()
+            if n_proc > 1 and n_seed % n_proc == 0:
+                grid = mesh_utils.create_hybrid_device_mesh(
+                    (n_seed // n_proc, n_data),
+                    dcn_mesh_shape=(n_proc, 1),
+                ).reshape(n_seed, n_data)
+            else:
+                grid = mesh_utils.create_device_mesh((n_seed, n_data))
+        except Exception as e:  # pragma: no cover - topology-dependent
+            import warnings
+
+            warnings.warn(
+                f"mesh_utils device-mesh construction failed ({e!r}); "
+                "falling back to positional device order — on multi-host "
+                "runs the 'data' axis psum may cross DCN",
+                RuntimeWarning, stacklevel=2)
+            grid = None
+    if grid is None:
+        grid = np.asarray(devices[:need]).reshape(n_seed, n_data)
     return Mesh(grid, (SEED_AXIS, DATA_AXIS))
 
 

@@ -119,29 +119,12 @@ def _overrides(cfg):
     return cfg
 
 
-def _resolve_cfg(name: str):
-    """Ladder preset, or "lru": the c2 geometry with the time-parallel
-    LRU model swapped in — the apples-to-apples fm/s comparison against
-    the LSTM's serial recurrence (models/lru.py)."""
-    import dataclasses as _dc
-
-    from lfm_quant_tpu.config import ModelConfig, get_preset
-
-    if name == "lru":
-        base = get_preset("c2")
-        return _dc.replace(
-            base, name="lru_c2_geometry",
-            model=ModelConfig(kind="lru",
-                              kwargs={"hidden": 128, "state_dim": 128},
-                              bf16=True))
-    return get_preset(name)
-
-
 def bench_config(name: str) -> dict:
+    from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.train import Trainer
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
 
-    cfg = _overrides(_resolve_cfg(name))
+    cfg = _overrides(get_preset(name))
     _log(f"{name}: building panel")
     splits = _bench_panel(cfg)
     if cfg.n_seeds > 1:

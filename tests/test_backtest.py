@@ -121,3 +121,51 @@ def test_aggregate_ensemble_modes():
     pv[3, 5, 5] = False
     _, v2 = aggregate_ensemble(fc, pv, "mean")
     assert not v2[5, 5] and v2[0, 0]
+
+
+def test_benchmark_relative_and_quantile_profile():
+    """Perfect forecast: positive excess over the EW-universe benchmark,
+    positive IR, and a rising quantile profile (bottom bucket < top)."""
+    p = toy_panel(n=50, t=36, seed=3)
+    fc = p.returns.copy()
+    rep = run_backtest(fc, np.ones_like(p.valid), p, quantile=0.1,
+                       min_universe=5)
+    assert rep.excess_cagr > 0.0
+    assert rep.ir_ann > 1.0
+    assert rep.t_stat > 0.0
+    assert rep.quantile_profile.shape == (10,)
+    assert rep.quantile_profile[-1] > rep.quantile_profile[0]
+    # benchmark = EW universe: monthly_bench must average the universe
+    np.testing.assert_allclose(rep.monthly_bench,
+                               p.returns.mean(axis=0), atol=1e-6)
+    # The profile buckets partition the universe: their mean matches the
+    # benchmark's overall mean up to equal-split rounding.
+    assert abs(float(rep.quantile_profile.mean())
+               - float(p.returns.mean())) < 5e-3
+
+
+def test_random_forecast_flat_profile():
+    """A random forecast must show no material quantile spread."""
+    p = toy_panel(n=100, t=36, seed=4)
+    rng = np.random.default_rng(7)
+    fc = rng.standard_normal(p.returns.shape).astype(np.float32)
+    rep = run_backtest(fc, np.ones_like(p.valid), p, quantile=0.1,
+                       min_universe=5)
+    spread = float(rep.quantile_profile[-1] - rep.quantile_profile[0])
+    assert abs(spread) < 5e-3
+    assert abs(rep.ir_ann) < 1.5
+
+
+def test_yearly_breakdown_compounds_to_total():
+    p = toy_panel(n=30, t=36, seed=6)
+    rep = run_backtest(p.returns.copy(), np.ones_like(p.valid), p,
+                       quantile=0.2, min_universe=5)
+    ys = rep.yearly()
+    assert sum(v["n_months"] for v in ys.values()) == rep.n_months
+    total = 1.0
+    for v in ys.values():
+        total *= 1.0 + v["ret"]
+    np.testing.assert_allclose(
+        total, float(np.prod(1.0 + rep.monthly_returns)), rtol=1e-6)
+    parsed = json.loads(rep.to_json())
+    assert "yearly" in parsed and len(parsed["yearly"]) == len(ys)

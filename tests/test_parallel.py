@@ -248,3 +248,12 @@ def test_dp_training_lru_matches_single_device(panel, tmp_path):
     for l1, l8 in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s8.params)):
         np.testing.assert_allclose(np.asarray(l1), np.asarray(l8),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_make_mesh_topology_path_spans_all_devices():
+    """The mesh_utils-built grid (full-device meshes) must contain every
+    device exactly once and keep the (seed, data) axis names."""
+    m = make_mesh(2, 4)
+    assert m.shape == {"seed": 2, "data": 4}
+    assert sorted(d.id for row in m.devices for d in row) == sorted(
+        d.id for d in jax.devices())
