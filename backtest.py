@@ -37,9 +37,13 @@ def main(argv=None) -> int:
     ap.add_argument("--long-short", action="store_true")
     ap.add_argument("--costs-bps", type=float, default=0.0)
     ap.add_argument("--mode", default="mean",
-                    choices=["mean", "mean_minus_std"],
+                    choices=["mean", "mean_minus_std",
+                             "mean_minus_total_std"],
                     help="aggregation over seeds (ensemble run dirs) or "
-                         "MC-dropout samples (--mc-samples)")
+                         "MC-dropout samples (--mc-samples); "
+                         "mean_minus_total_std adds the heteroscedastic "
+                         "head's aleatoric variance to the seed spread "
+                         "(ensemble run dirs with nll-trained members)")
     ap.add_argument("--risk-lambda", type=float, default=1.0)
     ap.add_argument("--mc-samples", type=int, default=0,
                     help="single-model run dirs: draw this many MC-dropout "
@@ -73,6 +77,10 @@ def main(argv=None) -> int:
         data = np.load(path)
         forecast, fc_valid = data["forecast"], data["valid"]
         panel = resolve_panel(cfg.data)
+        if args.mode == "mean_minus_total_std":
+            ap.error("--mode mean_minus_total_std needs live "
+                     "heteroscedastic models (a run dir); stitched "
+                     "forecast files store no aleatoric variances")
         if forecast.ndim == 3:  # stacked walk-forward ensemble
             forecast, fc_valid = aggregate_ensemble(
                 forecast, fc_valid, args.mode, args.risk_lambda)
@@ -91,17 +99,40 @@ def main(argv=None) -> int:
         if is_ensemble:
             from lfm_quant_tpu.train.ensemble import load_ensemble
             ens, splits = load_ensemble(args.run_dir)
-            stacked, stacked_valid = ens.predict(split)
-            forecast, fc_valid = aggregate_ensemble(
-                stacked, stacked_valid, args.mode, args.risk_lambda)
+            if args.mode == "mean_minus_total_std":
+                stacked, avar, stacked_valid = ens.predict(
+                    split, return_variance=True)
+                forecast, fc_valid = aggregate_ensemble(
+                    stacked, stacked_valid, args.mode, args.risk_lambda,
+                    aleatoric_var=avar)
+            else:
+                stacked, stacked_valid = ens.predict(split)
+                forecast, fc_valid = aggregate_ensemble(
+                    stacked, stacked_valid, args.mode, args.risk_lambda)
         else:
             from lfm_quant_tpu.train.loop import load_trainer
             trainer, splits = load_trainer(args.run_dir)
             if args.mc_samples > 0:
+                if args.mode == "mean_minus_total_std":
+                    ap.error("--mode mean_minus_total_std is not "
+                             "combinable with --mc-samples (dropout "
+                             "samples carry no aleatoric head variance); "
+                             "use --mode mean_minus_std")
                 stacked, fc_valid = trainer.predict(
                     split, mc_samples=args.mc_samples)
                 forecast, fc_valid = aggregate_ensemble(
                     stacked, fc_valid, args.mode, args.risk_lambda)
+            elif args.mode == "mean_minus_total_std":
+                # Single heteroscedastic model: no epistemic seed axis —
+                # the penalty reduces to the aleatoric head alone.
+                fc, avar, fc_valid = trainer.predict(
+                    split, return_variance=True)
+                forecast, fc_valid = aggregate_ensemble(
+                    fc[None], fc_valid, args.mode, args.risk_lambda,
+                    aleatoric_var=avar[None])
+            elif args.mode != "mean":
+                ap.error(f"--mode {args.mode} needs stacked forecasts: "
+                         "an ensemble run dir or --mc-samples")
             else:
                 forecast, fc_valid = trainer.predict(split)
         panel = splits.panel

@@ -99,6 +99,7 @@ def aggregate_ensemble(
     fc_valid: np.ndarray,
     mode: str = "mean",
     risk_lambda: float = 1.0,
+    aleatoric_var: Optional[np.ndarray] = None,
 ):
     """Combine stacked per-seed forecasts [S, N, T] → ([N, T], [N, T] valid).
 
@@ -106,7 +107,13 @@ def aggregate_ensemble(
       * "mean"           — ensemble average (the reference's multi-seed
         aggregation, SURVEY.md §4.3).
       * "mean_minus_std" — uncertainty-penalized score ``mean − λ·std``
-        (uncertainty-aware LFM lineage, SURVEY.md §1 [BACKGROUND]).
+        over the seed axis (epistemic only; uncertainty-aware LFM
+        lineage, SURVEY.md §1 [BACKGROUND]).
+      * "mean_minus_total_std" — ``mean − λ·sqrt(Var_seeds(mean_s) +
+        mean_s(var_s))``: the deep-ensemble mixture's total predictive
+        std (law of total variance — epistemic seed spread + mean
+        aleatoric head variance). Needs ``aleatoric_var`` [S, N, T] from
+        ``predict(return_variance=True)`` on heteroscedastic members.
     ``fc_valid`` may be [N, T] (shared) or [S, N, T] (per-seed; a cell is
     valid if ALL seeds predicted it).
     """
@@ -118,6 +125,17 @@ def aggregate_ensemble(
         score = mean
     elif mode == "mean_minus_std":
         score = mean - risk_lambda * forecasts.std(axis=0)
+    elif mode == "mean_minus_total_std":
+        if aleatoric_var is None:
+            raise ValueError(
+                "mean_minus_total_std needs aleatoric_var (predict with "
+                "return_variance=True on a heteroscedastic model)")
+        if aleatoric_var.shape != forecasts.shape:
+            raise ValueError(
+                f"aleatoric_var {aleatoric_var.shape} must match "
+                f"forecasts {forecasts.shape}")
+        total_var = forecasts.var(axis=0) + aleatoric_var.mean(axis=0)
+        score = mean - risk_lambda * np.sqrt(np.maximum(total_var, 0.0))
     else:
         raise ValueError(f"unknown ensemble mode {mode!r}")
     return np.where(valid, score, 0.0).astype(np.float32), valid

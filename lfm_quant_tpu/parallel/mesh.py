@@ -52,7 +52,8 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
     only traffic crossing DCN — none. Explicit ``devices`` or partial
     meshes fall back to the given order.
     """
-    devices = list(devices if devices is not None else jax.devices())
+    explicit = devices is not None
+    devices = list(devices if explicit else jax.devices())
     if n_data is None:
         if len(devices) % n_seed:
             raise ValueError(
@@ -64,7 +65,7 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
             f"mesh {n_seed}x{n_data} needs {need} devices, "
             f"have {len(devices)}")
     grid = None
-    if need == len(jax.devices()) and devices == list(jax.devices()):
+    if not explicit and need == len(devices):
         try:
             from jax.experimental import mesh_utils
 

@@ -127,6 +127,11 @@ class EnsembleTrainer:
         self._jit_forward = jax.jit(
             jax.vmap(self.inner._forward_impl, in_axes=(0, None, None, None, None))
         )
+        # Heteroscedastic twin: per-seed (mean, aleatoric variance) for
+        # the uncertainty-aware aggregation (mean_minus_total_std).
+        self._jit_forward_var = jax.jit(jax.vmap(
+            functools.partial(self.inner._forward_impl, variance=True),
+            in_axes=(0, None, None, None, None)))
 
     def _step_shards(self, state, dev, fi, ti, w):
         return self._vstep(state, dev, fi, ti, w)
@@ -277,12 +282,18 @@ class EnsembleTrainer:
     # ---- inference -----------------------------------------------------
 
     def predict(self, split: str = "test",
-                date_range: Optional[Tuple[int, int]] = None
-                ) -> Tuple[np.ndarray, np.ndarray]:
+                date_range: Optional[Tuple[int, int]] = None,
+                return_variance: bool = False):
         """Stacked forecasts [S, N, T] + shared validity [N, T] over the
         split's anchor range (or an explicit month-index ``date_range`` —
         the walk-forward fold window), for the backtest's ensemble
-        aggregation (SURVEY.md §4.3)."""
+        aggregation (SURVEY.md §4.3).
+
+        ``return_variance=True`` (heteroscedastic members) additionally
+        returns per-seed aleatoric variances [S, N, T]:
+        (forecasts, variances, valid) — consumed by
+        ``aggregate_ensemble(mode="mean_minus_total_std")``.
+        """
         d = self.cfg.data
         panel = self.splits.panel
         sampler = DateBatchSampler(
@@ -294,13 +305,22 @@ class EnsembleTrainer:
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
         b = sampler.stacked_cross_sections()
         fi, ti, w = self.inner._batch_args(b)
-        pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
+        if return_variance:
+            pred, var, _ = self._jit_forward_var(
+                self.state.params, self.dev, fi, ti, w)
+        else:
+            pred, _, _ = self._jit_forward(
+                self.state.params, self.dev, fi, ti, w)
         pred = np.asarray(pred)  # [S, M, bf]
         real = b.weight > 0  # [M, bf]
         rows = b.firm_idx[real]
         cols = np.broadcast_to(b.time_idx[:, None], b.firm_idx.shape)[real]
         out[:, rows, cols] = pred[:, real]
         out_valid[rows, cols] = True
+        if return_variance:
+            var_out = np.zeros_like(out)
+            var_out[:, rows, cols] = np.asarray(var)[:, real]
+            return out, var_out, out_valid
         return out, out_valid
 
 

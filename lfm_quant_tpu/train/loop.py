@@ -322,7 +322,8 @@ class Trainer:
                 self._step_impl, steps_axis=False))
             self._jit_multi_step = jax.jit(self._shard_mapped(
                 self._multi_step_impl, steps_axis=True))
-        self._jit_forward = jax.jit(self._forward_impl)
+        self._jit_forward = jax.jit(self._forward_impl,
+                                    static_argnames=("variance",))
 
     def _shard_mapped(self, impl, steps_axis: bool):
         """Wrap a step impl in shard_map over this trainer's mesh.
@@ -424,7 +425,7 @@ class Trainer:
         return jax.lax.scan(body, state, (fi, ti, w))
 
     def _forward_impl(self, params, dev: dict, firm_idx, time_idx, weight,
-                      rng=None):
+                      rng=None, variance: bool = False):
         """Eval forward: returns (pred [D,Bf], per-month IC [D], mse scalar).
 
         Chunked over the date axis with ``lax.map``: eval sweeps stack ALL
@@ -434,7 +435,12 @@ class Trainer:
 
         ``rng`` switches dropout LIVE (per-chunk keys) — the MC-dropout
         sampling path of :meth:`predict`; None is the deterministic eval.
+        ``variance`` (static) returns (mean, aleatoric variance, None)
+        from a heteroscedastic head instead of (pred, IC, mse) — the
+        uncertainty-aware-LFM prediction path (SURVEY.md §1 lineage).
         """
+        if variance and rng is not None:
+            raise ValueError("variance + MC-dropout sampling not supported")
         M = firm_idx.shape[0]
         C = min(self.cfg.data.dates_per_batch, M)
         pad = (-M) % C
@@ -453,9 +459,16 @@ class Trainer:
             fi, ti, w, *key = args
             x, m = self._gather(dev["xm"], fi, ti,
                                 impl=self._eval_gather_impl)
-            pred = _point_forecast(
-                self._apply(params, x, m, model=self.eval_model,
-                            rng=key[0] if key else None))
+            out = self._apply(params, x, m, model=self.eval_model,
+                              rng=key[0] if key else None)
+            if variance:
+                if not isinstance(out, tuple):
+                    raise ValueError(
+                        "variance=True needs a heteroscedastic head "
+                        "(ModelConfig.heteroscedastic / loss='nll')")
+                mean, log_var = out
+                return mean, jnp.exp(log_var.astype(jnp.float32))
+            pred = _point_forecast(out)
             if rng is not None:
                 # Sampling path: only the forecasts are consumed — skip
                 # the per-month ranking/error metrics K times over.
@@ -465,6 +478,10 @@ class Trainer:
             se = (w * (pred.astype(jnp.float32) - y) ** 2).sum(axis=-1)
             return pred, ic, se, w.sum(axis=-1)
 
+        if variance:
+            mean, var = jax.lax.map(chunk, tuple(chunks))
+            return (mean.reshape(nc * C, -1)[:M],
+                    var.reshape(nc * C, -1)[:M], None)
         if rng is not None:
             pred = jax.lax.map(chunk, tuple(chunks))
             return pred.reshape(nc * C, -1)[:M], None, None
@@ -587,13 +604,19 @@ class Trainer:
         }
 
     def predict(self, split: str = "test", mc_samples: int = 0,
-                mc_seed: int = 0, date_range: Optional[Tuple[int, int]] = None
-                ) -> Tuple[np.ndarray, np.ndarray]:
+                mc_seed: int = 0, date_range: Optional[Tuple[int, int]] = None,
+                return_variance: bool = False):
         """Forecasts for every eligible anchor in a split's date range.
 
         Returns (forecast [N, T] float32, pred_valid [N, T] bool) over the
         FULL panel shape, with pred_valid True only inside the split range —
         the backtest engine's input (SURVEY.md §4.3).
+
+        ``return_variance=True`` (heteroscedastic models only, not
+        combinable with ``mc_samples``) returns
+        (forecast, aleatoric_variance [N, T], pred_valid) — the per-firm
+        predicted noise level the uncertainty-aware aggregation consumes
+        (``aggregate_ensemble(mode="mean_minus_total_std")``).
 
         ``mc_samples > 0`` switches to **MC-dropout sampling** (the
         uncertainty-aware LFM lineage's single-model alternative to deep
@@ -628,6 +651,10 @@ class Trainer:
         out_valid[rows, cols] = True
 
         if mc_samples > 0:
+            if return_variance:
+                raise ValueError(
+                    "return_variance is not combinable with mc_samples — "
+                    "MC sampling already carries the uncertainty")
             # Same jitted eval forward; the 6-arg (rng) signature gets its
             # own cached trace with dropout live and metrics skipped.
             out = np.zeros((mc_samples, panel.n_firms, panel.n_months),
@@ -641,6 +668,13 @@ class Trainer:
             return out, out_valid
 
         out = np.zeros((panel.n_firms, panel.n_months), np.float32)
+        if return_variance:
+            var_out = np.zeros_like(out)
+            pred, var, _ = self._jit_forward(
+                self.state.params, self.dev, fi, ti, w, variance=True)
+            out[rows, cols] = np.asarray(pred)[real]
+            var_out[rows, cols] = np.asarray(var)[real]
+            return out, var_out, out_valid
         pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
         out[rows, cols] = np.asarray(pred)[real]
         return out, out_valid

@@ -126,3 +126,37 @@ def test_requires_two_seeds(panel, tmp_path):
     splits = PanelSplits.by_date(panel, 197910, 198101)
     with pytest.raises(ValueError, match="n_seeds"):
         EnsembleTrainer(ens_cfg(tmp_path, n_seeds=1), splits)
+
+
+def test_heteroscedastic_ensemble_variance_and_total_std(panel, tmp_path):
+    """NLL-trained members expose per-seed aleatoric variance, and the
+    mean_minus_total_std aggregation penalizes at least as hard as the
+    epistemic-only mode."""
+    from lfm_quant_tpu.data import PanelSplits
+
+    cfg = ens_cfg(tmp_path, n_seeds=2,
+                  optim=OptimConfig(lr=3e-3, epochs=2, warmup_steps=5,
+                                    early_stop_patience=3, loss="nll"))
+    dates = panel.dates
+    splits = PanelSplits.by_date(panel, int(dates[100]), int(dates[120]))
+    tr = EnsembleTrainer(cfg, splits)
+    tr.state = tr.init_state()
+    tr.fit()
+    stacked, avar, valid = tr.predict("test", return_variance=True)
+    assert stacked.shape == avar.shape == (2, panel.n_firms, panel.n_months)
+    assert (avar[:, valid] > 0).all(), "aleatoric variance must be positive"
+    total, _ = aggregate_ensemble(stacked, valid, "mean_minus_total_std",
+                                  aleatoric_var=avar)
+    epist, _ = aggregate_ensemble(stacked, valid, "mean_minus_std")
+    assert (total[valid] <= epist[valid] + 1e-6).all()
+    # hand-check one cell
+    s, e = stacked[:, valid], avar[:, valid]
+    expect = s.mean(0) - np.sqrt(s.var(0) + e.mean(0))
+    np.testing.assert_allclose(total[valid], expect, rtol=1e-5, atol=1e-6)
+
+
+def test_total_std_mode_requires_variance():
+    fc = np.zeros((3, 4, 5), np.float32)
+    valid = np.ones((4, 5), bool)
+    with pytest.raises(ValueError, match="aleatoric_var"):
+        aggregate_ensemble(fc, valid, "mean_minus_total_std")
