@@ -31,6 +31,10 @@ class DataConfig:
     train_end: Optional[int] = None
     val_end: Optional[int] = None
     panel_path: Optional[str] = None  # load a real panel instead of synthetic
+    # Which (standardized) feature column the model forecasts ``horizon``
+    # months ahead — real panels only (data/compustat.py); None = the
+    # file's first feature column.
+    target_col: Optional[str] = None
     panel_seed: int = 0
     # Epoch index sampling: "python" (numpy RNG), "native" (C++ sampler,
     # lfm_quant_tpu/native/), "auto" (native when built). The two engines

@@ -690,7 +690,8 @@ def resolve_panel(d) -> Panel:
         if d.panel_path.endswith((".csv", ".parquet", ".pq")):
             from lfm_quant_tpu.data.compustat import load_compustat_csv
 
-            panel = load_compustat_csv(d.panel_path, horizon=d.horizon)
+            panel = load_compustat_csv(d.panel_path, horizon=d.horizon,
+                                       target_col=d.target_col)
         else:
             panel = load_panel(d.panel_path)
     else:

@@ -201,6 +201,12 @@ def test_csv_reachable_from_config(tmp_path):
     p = resolve_panel(DataConfig(panel_path=path, horizon=6))
     assert p.feature_names == ["ebit_ev", "bm", "mom"]
     assert p.n_firms == 40
+    # target_col flows through the config surface: targets become the
+    # chosen column's standardized lead, not the first column's.
+    p_bm = resolve_panel(DataConfig(panel_path=path, horizon=6,
+                                    target_col="bm"))
+    both = p.target_valid & p_bm.target_valid
+    assert not np.allclose(p.targets[both], p_bm.targets[both])
 
 
 def test_train_on_loaded_panel(tmp_path):
