@@ -195,6 +195,13 @@ def bench_c5_ensemble() -> None:
 
 
 def main() -> int:
+    # Hang forensics: the tunneled device has wedged before (a remote
+    # compile that never returns leaves the client in a silent sleep
+    # poll). Periodic all-thread stack dumps to stderr cost nothing and
+    # turn a dead driver run into a diagnosable one.
+    import faulthandler
+
+    faulthandler.dump_traceback_later(600, repeat=True)
     bench_c2()
     try:
         bench_c5_ensemble()
@@ -202,6 +209,8 @@ def main() -> int:
         print(f"bench_c5_ensemble failed: {type(e).__name__}: {e}",
               file=sys.stderr)
         return 1
+    finally:
+        faulthandler.cancel_dump_traceback_later()
     return 0
 
 
