@@ -78,10 +78,18 @@ def main(argv=None) -> int:
         forecast, fc_valid = data["forecast"], data["valid"]
         panel = resolve_panel(cfg.data)
         if args.mode == "mean_minus_total_std":
-            ap.error("--mode mean_minus_total_std needs live "
-                     "heteroscedastic models (a run dir); stitched "
-                     "forecast files store no aleatoric variances")
-        if forecast.ndim == 3:  # stacked walk-forward ensemble
+            if "variance" not in data:
+                ap.error("--mode mean_minus_total_std needs stitched "
+                         "aleatoric variances; this file has none (train "
+                         "the walk-forward with a heteroscedastic config "
+                         "— loss='nll')")
+            avar = data["variance"]
+            if forecast.ndim == 2:  # single heteroscedastic model
+                forecast, avar = forecast[None], avar[None]
+            forecast, fc_valid = aggregate_ensemble(
+                forecast, fc_valid, args.mode, args.risk_lambda,
+                aleatoric_var=avar)
+        elif forecast.ndim == 3:  # stacked walk-forward ensemble
             forecast, fc_valid = aggregate_ensemble(
                 forecast, fc_valid, args.mode, args.risk_lambda)
         elif args.mode != "mean":

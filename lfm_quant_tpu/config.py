@@ -93,6 +93,13 @@ class RunConfig:
     n_data_shards: int = 1  # data-parallel axis size
     out_dir: str = "runs"
 
+    @property
+    def is_heteroscedastic(self) -> bool:
+        """Whether the built model carries a (mean, log_var) head — the
+        single source of truth shared by model building (model_kwargs)
+        and the variance-stitching prediction paths."""
+        return self.model.heteroscedastic or self.optim.loss == "nll"
+
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
 
@@ -203,7 +210,7 @@ def model_kwargs(cfg: RunConfig, mesh=None,
     kw = dict(cfg.model.kwargs)
     if cfg.model.bf16:
         kw["dtype"] = jnp.bfloat16
-    if cfg.model.heteroscedastic or cfg.optim.loss == "nll":
+    if cfg.is_heteroscedastic:
         kw["heteroscedastic"] = True
     if cfg.model.kind in ("lstm", "gru"):
         if "scan_impl" not in kw:

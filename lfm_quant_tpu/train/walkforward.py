@@ -90,7 +90,11 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     (single) or [S, N, T] (ensemble — aggregate downstream exactly like
     ``EnsembleTrainer.predict`` output), valid is [N, T] and True only in
     the stitched out-of-sample months, and summary carries per-fold
-    records. When ``out_dir`` is set, each fold's run dir lands under
+    records. Heteroscedastic configs (``model.heteroscedastic`` or
+    ``loss="nll"``) additionally stitch per-fold aleatoric variances into
+    the saved ``walkforward.npz`` (key ``variance``, forecast-shaped) so
+    ``backtest.py --forecast-npz --mode mean_minus_total_std`` works on
+    the strictly-out-of-sample panel. When ``out_dir`` is set, each fold's run dir lands under
     ``<out_dir>/fold_<k>``, a progress snapshot (``partial.npz`` +
     ``partial.json``) is written after every fold, and ``walkforward.npz``
     + ``summary.json`` at the end.
@@ -104,8 +108,12 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
 
     folds = walkforward_folds(panel, start, step_months, val_months, n_folds)
     ensemble = cfg.n_seeds > 1
+    # Heteroscedastic members: stitch per-fold aleatoric variances too,
+    # so the stitched file supports mean_minus_total_std downstream.
+    het = cfg.is_heteroscedastic
     lead = (cfg.n_seeds,) if ensemble else ()
     forecast = np.zeros(lead + (panel.n_firms, panel.n_months), np.float32)
+    variance = np.zeros_like(forecast) if het else None
     valid = np.zeros((panel.n_firms, panel.n_months), bool)
     records: List[Dict[str, Any]] = []
 
@@ -118,6 +126,13 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
         if os.path.exists(partial_npz):
             snap = np.load(partial_npz)
             forecast, valid = snap["forecast"], snap["valid"].astype(bool)
+            if het:
+                if "variance" not in snap:
+                    raise ValueError(
+                        "resume snapshot lacks variances but the config "
+                        "is heteroscedastic — snapshot from a different "
+                        "model config?")
+                variance = snap["variance"]
             with open(partial_json) as fh:
                 records = json.load(fh)
             if len(records) > len(folds):
@@ -149,7 +164,12 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
         trainer = (EnsembleTrainer if ensemble else Trainer)(
             fold_cfg, splits, run_dir=run_dir, echo=echo)
         fit = trainer.fit(resume=resume and run_dir is not None)
-        fc, v = trainer.predict(date_range=pred_range)
+        if het:
+            fc, avar, v = trainer.predict(date_range=pred_range,
+                                          return_variance=True)
+            variance[..., v] = avar[..., v]
+        else:
+            fc, v = trainer.predict(date_range=pred_range)
         assert not (valid & v).any(), "fold prediction windows overlap"
         forecast[..., v] = fc[..., v]
         valid |= v
@@ -165,7 +185,9 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
         })
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
-            np.savez_compressed(partial_npz, forecast=forecast, valid=valid)
+            extra = {"variance": variance} if het else {}
+            np.savez_compressed(partial_npz, forecast=forecast, valid=valid,
+                                **extra)
             with open(partial_json, "w") as fh:
                 json.dump(records, fh)
     summary = {
@@ -179,8 +201,9 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     }
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
+        extra = {"variance": variance} if het else {}
         np.savez_compressed(os.path.join(out_dir, "walkforward.npz"),
-                            forecast=forecast, valid=valid)
+                            forecast=forecast, valid=valid, **extra)
         with open(os.path.join(out_dir, "config.json"), "w") as fh:
             fh.write(cfg.to_json())
         with open(os.path.join(out_dir, "summary.json"), "w") as fh:

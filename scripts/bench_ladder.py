@@ -155,7 +155,7 @@ def bench_config(name: str) -> dict:
 
 
 def main(argv) -> int:
-    names = argv or ["c1", "c2", "c3", "c4", "c5"]
+    names = argv or ["c1", "c2", "c3", "c4", "c5", "lru"]
     for name in names:
         rec = bench_config(name)
         print(json.dumps(rec), flush=True)

@@ -153,3 +153,16 @@ def test_bf16_c2_geometry_lowers():
     _lower_tpu(jax.grad(loss, argnums=(0, 1)),
                _sds((B, T, G), jnp.bfloat16), _sds((H, G), jnp.bfloat16),
                _sds((B, T), jnp.bfloat16))
+
+
+def test_gather_c1_geometry_f32_lowers():
+    """The c1 ladder shape family — f32 panel (no bf16), window=12,
+    lane-padded width 128 — the exact geometry whose on-chip run wedged
+    the tunnel once; pin at least the Mosaic lowering stage."""
+    N, T, Fp, W = 1000, 240, 128, 12
+
+    _lower_tpu(
+        lambda xm, a, b: gather_windows_pallas(xm, a, b, window=W, fp=6,
+                                               interpret=False),
+        _sds((N, T, Fp), jnp.float32),
+        _sds((8, 128), jnp.int32), _sds((8,), jnp.int32))

@@ -153,3 +153,26 @@ def test_walkforward_rejects_bad_step(panel):
         walkforward_folds(panel, start=198001, step_months=0, val_months=24)
     with pytest.raises(ValueError, match="step_months"):
         walkforward_folds(panel, start=198001, step_months=-12, val_months=24)
+
+
+def test_walkforward_nll_stitches_variances_and_total_std(tmp_path):
+    """Heteroscedastic walk-forward: variances land in walkforward.npz
+    and backtest.py --mode mean_minus_total_std consumes the file."""
+    import backtest as bt_cli
+
+    from lfm_quant_tpu.train.loop import resolve_panel
+
+    cfg = _cfg(tmp_path, n_seeds=2)
+    cfg = dataclasses.replace(
+        cfg, optim=dataclasses.replace(cfg.optim, loss="nll"))
+    panel = resolve_panel(cfg.data)
+    fc, valid, _ = run_walkforward(cfg, panel, start=198001, step_months=12,
+                                   val_months=24, n_folds=2,
+                                   out_dir=str(tmp_path / "wf"))
+    data = np.load(tmp_path / "wf" / "walkforward.npz")
+    assert "variance" in data
+    assert data["variance"].shape == fc.shape == (2, 100, 200)
+    assert (data["variance"][:, valid] > 0).all()
+    rc = bt_cli.main(["--forecast-npz", str(tmp_path / "wf"),
+                      "--quantile", "0.3", "--mode", "mean_minus_total_std"])
+    assert rc == 0
