@@ -43,7 +43,8 @@ def main(argv=None) -> int:
                          "MC-dropout samples (--mc-samples); "
                          "mean_minus_total_std adds the heteroscedastic "
                          "head's aleatoric variance to the seed spread "
-                         "(ensemble run dirs with nll-trained members)")
+                         "(nll-trained run dirs, or --forecast-npz files "
+                         "stitched from an nll walk-forward)")
     ap.add_argument("--risk-lambda", type=float, default=1.0)
     ap.add_argument("--mc-samples", type=int, default=0,
                     help="single-model run dirs: draw this many MC-dropout "

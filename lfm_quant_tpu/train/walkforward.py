@@ -94,7 +94,8 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     ``loss="nll"``) additionally stitch per-fold aleatoric variances into
     the saved ``walkforward.npz`` (key ``variance``, forecast-shaped) so
     ``backtest.py --forecast-npz --mode mean_minus_total_std`` works on
-    the strictly-out-of-sample panel. When ``out_dir`` is set, each fold's run dir lands under
+    the strictly-out-of-sample panel. When ``out_dir`` is set, each
+    fold's run dir lands under
     ``<out_dir>/fold_<k>``, a progress snapshot (``partial.npz`` +
     ``partial.json``) is written after every fold, and ``walkforward.npz``
     + ``summary.json`` at the end.

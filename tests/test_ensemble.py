@@ -160,3 +160,17 @@ def test_total_std_mode_requires_variance():
     valid = np.ones((4, 5), bool)
     with pytest.raises(ValueError, match="aleatoric_var"):
         aggregate_ensemble(fc, valid, "mean_minus_total_std")
+
+
+def test_lru_ensemble_trains(panel, tmp_path):
+    """The associative-scan LRU composes with the seed-vmapped ensemble
+    (generic batching over the scan) — guard the kind=lru + n_seeds>1
+    path end to end."""
+    cfg = ens_cfg(tmp_path, n_seeds=2,
+                  model=ModelConfig(kind="lru",
+                                    kwargs={"hidden": 16, "state_dim": 16}))
+    summary, tr, _ = run_ensemble_experiment(cfg, panel=panel)
+    assert summary["n_seeds"] == 2
+    stacked, valid = tr.predict("test")
+    assert stacked.shape[0] == 2
+    assert not np.allclose(stacked[0][valid], stacked[1][valid])
