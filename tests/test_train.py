@@ -188,3 +188,23 @@ def test_lru_trains_end_to_end(panel, tmp_path):
     assert summary["history"][-1]["train_loss"] < summary["history"][0][
         "train_loss"]
     assert summary["best_val_ic"] > 0.05
+
+
+def test_bench_ladder_gather_override(monkeypatch):
+    """LFM_BENCH_GATHER_IMPL must reroute the window gather; scan_impl
+    overrides must not leak onto non-RNN models (the lru target)."""
+    import sys as _sys
+
+    _sys.path.insert(0, "scripts")
+    import bench_ladder
+
+    from lfm_quant_tpu.config import get_preset
+
+    monkeypatch.setenv("LFM_BENCH_GATHER_IMPL", "xla")
+    cfg = bench_ladder._overrides(get_preset("c2"))
+    assert cfg.data.gather_impl == "xla"
+    monkeypatch.setenv("LFM_BENCH_SCAN_IMPL", "pallas_fused")
+    cfg = bench_ladder._overrides(get_preset("lru"))
+    assert "scan_impl" not in cfg.model.kwargs  # lru: RNN-only knob
+    cfg = bench_ladder._overrides(get_preset("c2"))
+    assert cfg.model.kwargs["scan_impl"] == "pallas_fused"
