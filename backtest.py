@@ -54,6 +54,8 @@ def main(argv=None) -> int:
                          "ensemble")
     ap.add_argument("--json-out", default=None,
                     help="write the full report JSON here")
+    ap.add_argument("--yearly", action="store_true",
+                    help="also print the calendar-year breakdown")
     args = ap.parse_args(argv)
 
     from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
@@ -152,6 +154,10 @@ def main(argv=None) -> int:
         costs_bps=args.costs_bps,
     )
     print(report.summary())
+    if args.yearly:
+        for y, rec in sorted(report.yearly().items()):
+            print(f"  {y}: ret {rec['ret']:+8.2%}  bench {rec['bench']:+8.2%}"
+                  f"  IC {rec['mean_ic']:+.3f}  ({rec['n_months']} mo)")
     if args.json_out:
         with open(args.json_out, "w") as fh:
             fh.write(report.to_json())

@@ -193,9 +193,10 @@ def test_lru_trains_end_to_end(panel, tmp_path):
 def test_bench_ladder_gather_override(monkeypatch):
     """LFM_BENCH_GATHER_IMPL must reroute the window gather; scan_impl
     overrides must not leak onto non-RNN models (the lru target)."""
-    import sys as _sys
+    import os as _os
 
-    _sys.path.insert(0, "scripts")
+    monkeypatch.syspath_prepend(
+        _os.path.join(_os.path.dirname(__file__), "..", "scripts"))
     import bench_ladder
 
     from lfm_quant_tpu.config import get_preset
