@@ -1,0 +1,44 @@
+#!/bin/bash
+# On-chip measurement campaign — fills BASELINE.md's pending ladder rows
+# after a tunnel outage (see BASELINE.md's 2026-07-30 note). Ordered so a
+# re-wedge loses the least: driver metrics first, the c1 suspect LAST.
+# Every step is timeboxed and logged; a timeout on a non-c1 step means
+# the tunnel wedged again and the campaign aborts.
+#
+# Usage: bash scripts/chip_campaign.sh [logfile]
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/campaign.log}
+echo "=== campaign start $(date) ===" | tee -a "$LOG"
+
+step() {
+  name=$1; shift
+  echo "--- $name: $* ($(date +%H:%M:%S))" | tee -a "$LOG"
+  timeout "$TMO" "$@" >> "$LOG" 2>&1
+  rc=$?
+  echo "--- $name rc=$rc" | tee -a "$LOG"
+  case "$name" in
+    c1*) ;;  # expected-risky steps don't abort the campaign
+    *) if [ $rc -ne 0 ]; then
+         echo "!!! $name failed — aborting (tunnel may be wedged)" | tee -a "$LOG"
+         exit $rc
+       fi ;;
+  esac
+}
+
+TMO=120 step probe python -c "
+import jax, jax.numpy as jnp
+print('TUNNEL_OK', float(jax.jit(lambda a: a@a)(jnp.ones((256,256), jnp.bfloat16)).sum()))"
+
+TMO=600 step bench python bench.py
+TMO=600 step ladder-c3 python scripts/bench_ladder.py c3
+TMO=600 step ladder-c4 python scripts/bench_ladder.py c4
+TMO=600 step ladder-lru python scripts/bench_ladder.py lru
+TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
+
+# The c1 suspect, isolated and LAST (see scripts/diag_c1.py): first the
+# XLA gather (rules out the MLP program), then the Pallas DMA gather.
+TMO=420 step c1diag-xla python scripts/diag_c1.py xla 5
+TMO=420 step c1diag-pallas python scripts/diag_c1.py - 5
+TMO=600 step c1 python scripts/bench_ladder.py c1
+
+echo "=== campaign done $(date) ===" | tee -a "$LOG"

@@ -1,0 +1,76 @@
+"""Staged c1 bench diagnostic: per-stage prints + periodic stack dumps.
+
+The first on-chip `bench_ladder.py c1` run (2026-07-30) hung with no
+output and left the axon tunnel wedged for every subsequent client (see
+BASELINE.md's outage note). This script re-runs the same measurement
+stage by stage — panel build, trainer build (device_put), state init,
+batch staging, one multi-step dispatch, readback, full measure — with
+per-stage timing prints and all-thread stack dumps to stderr every 60 s,
+so a recurrence pinpoints the exact blocking frame.
+
+Run:  python scripts/diag_c1.py [gather_impl|-] [k]
+  gather_impl: xla | pallas | - (config default; auto→pallas on TPU).
+    Diagnose with "xla" FIRST (rules out the MLP program), then "-"
+    (the Pallas DMA gather — the prime suspect: c1 is the only f32
+    ladder config, and only bf16 gathers have ever run on chip).
+  k: steps per dispatch (default 5).
+DIAG_CPU=1 forces the CPU backend (sanity check of the script itself).
+"""
+import dataclasses
+import faulthandler
+import os as _os
+import sys
+import time
+
+faulthandler.dump_traceback_later(60, repeat=True)
+
+_repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+sys.path.insert(0, _os.path.join(_repo, "scripts"))
+
+t0 = time.time()
+
+
+def stage(msg):
+    print(f"[{time.time()-t0:7.1f}s] {msg}", flush=True)
+
+
+stage("importing jax")
+import os  # noqa: E402
+
+import jax  # noqa: E402
+
+if os.environ.get("DIAG_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+stage(f"backend={jax.default_backend()} devices={jax.devices()}")
+
+from bench import measure_trainer  # noqa: E402
+from bench_ladder import _bench_panel  # noqa: E402
+from lfm_quant_tpu.config import get_preset  # noqa: E402
+from lfm_quant_tpu.train import Trainer  # noqa: E402
+
+cfg = get_preset("c1")
+if len(sys.argv) > 1 and sys.argv[1] != "-":
+    cfg = dataclasses.replace(
+        cfg, data=dataclasses.replace(cfg.data, gather_impl=sys.argv[1]))
+k = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+stage("building panel")
+splits = _bench_panel(cfg)
+stage("building trainer (device_put panel)")
+tr = Trainer(cfg, splits)
+stage(f"trainer built; gather_impl={tr._gather_impl}")
+state = tr.init_state()
+stage("state init done")
+b = tr.train_sampler.stacked_epoch(0)
+b = dataclasses.replace(b, firm_idx=b.firm_idx[:k], time_idx=b.time_idx[:k],
+                        weight=b.weight[:k])
+fi, ti, w = tr._batch_args(b, train=True, steps=True)
+stage(f"batch staged k={k}; dispatching multi-step (compile)")
+_, ms = tr._jit_multi_step(state, tr.dev, fi, ti, w)
+stage("dispatched; forcing readback")
+loss = float(ms["loss"][-1])
+stage(f"readback done loss={loss:.5f}")
+v = measure_trainer(tr, k=k, reps=1)
+stage(f"measured {v:.0f} fm/s")
+faulthandler.cancel_dump_traceback_later()
