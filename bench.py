@@ -202,16 +202,17 @@ def main() -> int:
     import faulthandler
 
     faulthandler.dump_traceback_later(600, repeat=True)
-    bench_c2()
     try:
-        bench_c5_ensemble()
-    except Exception as e:  # noqa: BLE001 — c2 result must still reach the driver
-        print(f"bench_c5_ensemble failed: {type(e).__name__}: {e}",
-              file=sys.stderr)
-        return 1
+        bench_c2()
+        try:
+            bench_c5_ensemble()
+        except Exception as e:  # noqa: BLE001 — c2 result must still reach the driver
+            print(f"bench_c5_ensemble failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        return 0
     finally:
         faulthandler.cancel_dump_traceback_later()
-    return 0
 
 
 if __name__ == "__main__":

@@ -197,11 +197,14 @@ def run_backtest(
         rets.append(port_ret)
         benches.append(float(panel.returns[uni, t].mean()))
         month_rets = panel.returns[uni[order], t]  # sorted by forecast
-        for b, chunk in enumerate(np.array_split(month_rets,
-                                                 profile_buckets)):
-            if chunk.size:  # thin months leave high buckets untouched
-                profile_sum[b] += float(chunk.mean())
-                profile_cnt[b] += 1
+        # Map each sorted name to bucket floor(rank*B/n): in thin months
+        # (n < profile_buckets) names keep their forecast-rank position —
+        # the top-forecast name still lands in the top bucket and only
+        # mid buckets go empty, so the monotonicity profile stays honest.
+        bucket_of = (np.arange(uni.size) * profile_buckets) // uni.size
+        for b in np.unique(bucket_of):
+            profile_sum[b] += float(month_rets[bucket_of == b].mean())
+            profile_cnt[b] += 1
         ics.append(_spearman(f, panel.targets[uni, t])
                    if panel.target_valid[uni, t].any() else 0.0)
         ret_ics.append(_spearman(f, panel.returns[uni, t]))

@@ -177,9 +177,17 @@ def _ladder() -> Dict[str, RunConfig]:
 
 PRESETS: Dict[str, RunConfig] = _ladder()
 # Short aliases derived from the names themselves ("c2_lstm_single" →
-# "c2", "lru_c2_geometry" → "lru") — immune to ladder reordering.
-PRESETS.update({name.split("_")[0]: cfg
-                for name, cfg in _ladder().items()})
+# "c2", "lru_c2_geometry" → "lru") — immune to ladder reordering. Alias
+# collisions (two presets sharing a first token, or an alias shadowing a
+# full name) must fail loudly at import time, not silently last-wins.
+for _name, _cfg in list(PRESETS.items()):
+    _alias = _name.split("_")[0]
+    if _alias in PRESETS and PRESETS[_alias] is not _cfg:
+        raise ValueError(
+            f"preset alias {_alias!r} (from {_name!r}) collides with an "
+            f"existing preset/alias; rename the preset")
+    PRESETS[_alias] = _cfg
+del _name, _cfg, _alias
 
 
 def get_preset(name: str) -> RunConfig:

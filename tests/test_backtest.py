@@ -144,6 +144,26 @@ def test_benchmark_relative_and_quantile_profile():
                - float(p.returns.mean())) < 5e-3
 
 
+def test_thin_month_profile_keeps_rank_position():
+    """When a month's universe is smaller than profile_buckets, each name
+    must land in the bucket matching its forecast rank — the single
+    top-forecast name goes to the TOP bucket, not bucket 0 (the old
+    array_split behavior filled from the bottom)."""
+    p = toy_panel(n=6, t=36, seed=9)
+    fc = p.returns.copy()  # perfect forecast: rank == realized return rank
+    rep = run_backtest(fc, np.ones_like(p.valid), p, quantile=0.2,
+                       min_universe=5, profile_buckets=10)
+    # 6 names → bucket floor(rank*10/6) ∈ {0,1,3,5,6,8}: top bucket index
+    # used is 8, and the top-ranked (highest-return) name populates it.
+    prof = rep.quantile_profile
+    top = p.returns.max(axis=0).mean()
+    bottom = p.returns.min(axis=0).mean()
+    np.testing.assert_allclose(prof[8], top, atol=1e-6)
+    np.testing.assert_allclose(prof[0], bottom, atol=1e-6)
+    # Buckets no name ever maps to stay empty (NaN or 0 count → reported 0)
+    assert prof[9] == 0.0 and prof[2] == 0.0
+
+
 def test_random_forecast_flat_profile():
     """A random forecast must show no material quantile spread."""
     p = toy_panel(n=100, t=36, seed=4)
