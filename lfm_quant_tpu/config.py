@@ -25,6 +25,10 @@ class DataConfig:
     window: int = 60
     horizon: int = 12
     dates_per_batch: int = 8
+    # Firms sampled per month row; 0 = FULL UNIVERSE (every batch row
+    # carries a month's entire eligible cross-section, padded to a static
+    # rounded max — what the c3 rank-IC objective requires, BASELINE.json:9;
+    # a positive value is the explicit subsampling approximation).
     firms_per_date: int = 128
     min_valid_months: Optional[int] = None
     # Date splits (YYYYMM): computed from panel range when None.
@@ -136,8 +140,11 @@ def _ladder() -> Dict[str, RunConfig]:
     )
     c3 = RunConfig(
         name="c3_gru_rank_ic",
+        # firms_per_date=0: the rank-IC loss ranks each month's FULL
+        # eligible cross-section (~8000 firms), as the spec requires —
+        # not a subsample. Set a positive value to opt into subsampling.
         data=DataConfig(n_firms=8000, n_months=480, n_features=20, window=60,
-                        dates_per_batch=8, firms_per_date=512),
+                        dates_per_batch=8, firms_per_date=0),
         model=ModelConfig(kind="gru", kwargs={"hidden": 128}, bf16=True),
         optim=OptimConfig(lr=5e-4, epochs=30, loss="rank_ic"),
         n_data_shards=8,

@@ -38,6 +38,14 @@ import numpy as np
 from lfm_quant_tpu.data.panel import Panel
 
 
+# Firm-axis chunk for the XLA row gather at full-universe widths: the fast
+# path materializes [D, Bf, T, F] firm rows, which at Bf ≈ 8000 full
+# cross-sections is T/W × the window bytes (~GBs); chunking bounds the
+# transient to [D, FIRM_CHUNK, T, F] per lax.map step. Full-universe Bf is
+# rounded to a multiple of this so the chunks always divide evenly.
+FIRM_CHUNK = 512
+
+
 @dataclasses.dataclass
 class WindowIndex:
     """One batch of window anchors, in the [D, Bf] per-date layout.
@@ -106,6 +114,15 @@ class DateBatchSampler:
         indices [lo, hi) — the split mechanism (PanelSplits): windows still
         reach back before ``lo`` for history; only anchors are bounded.
 
+        ``firms_per_date=0`` selects FULL-UNIVERSE mode (BASELINE.json:9 —
+        the c3 rank-IC loss ranks the full monthly cross-section): every
+        batch row carries a date's ENTIRE eligible pool, padded to a static
+        Bf = the largest pool, rounded up (multiple of FIRM_CHUNK=512 for
+        pools ≥ 2×FIRM_CHUNK=1024 so the firm-chunked gather divides
+        evenly, else 8 for sublane tiling).
+        Padding is repetition at weight 0, exactly like thin dates in
+        subsampled mode.
+
         ``engine``: "python" (numpy RNG, the determinism contract tests pin
         down), "native" (the C++ sampler in lfm_quant_tpu/native/ — its own
         deterministic order keyed by (seed, epoch), ~18× faster epoch
@@ -113,6 +130,10 @@ class DateBatchSampler:
         (native when built, else python)."""
         self.window = window
         self.dates_per_batch = dates_per_batch
+        if firms_per_date < 0:
+            raise ValueError(
+                f"firms_per_date must be >= 0 (0 = full universe), got "
+                f"{firms_per_date}")
         self.firms_per_date = firms_per_date
         self.seed = seed
         if engine not in ("python", "native", "auto"):
@@ -148,6 +169,11 @@ class DateBatchSampler:
             int(t): np.nonzero(eligible[:, t])[0].astype(np.int32)
             for t in self._all_dates
         }
+        if self.firms_per_date == 0:
+            # Full-universe mode: static Bf from the largest TRAINING pool.
+            mx = max(self._firms_by_date[int(t)].size for t in self._dates)
+            mult = FIRM_CHUNK if mx >= 2 * FIRM_CHUNK else 8
+            self.firms_per_date = -(-mx // mult) * mult
         # CSR pools over the TRAINING dates, for the native sampler.
         pools = [self._firms_by_date[int(t)] for t in self._dates]
         self._pool_offs = np.zeros(len(pools) + 1, np.int64)
@@ -466,6 +492,7 @@ def gather_windows_packed(
     time_idx: jax.Array,
     window: int,
     fp: Optional[int] = None,
+    firm_chunk: Optional[int] = None,
 ):
     """Hot-path window gather over the packed panel (``device_panel``'s
     ``xm``: ``[N, T, F+1]`` with validity as the last column).
@@ -481,12 +508,39 @@ def gather_windows_packed(
     when ``xm`` is lane-padded for the Pallas DMA gather
     (``device_panel(..., lane_pad=True)``) — the validity column then sits
     at ``fp - 1``, not at the (zero-padding) last column.
+
+    ``firm_chunk``: chunk the firm axis with ``lax.map`` so the [D, Bf, T,
+    Fp] row transient never materializes whole — required at full-universe
+    widths (Bf ≈ the whole cross-section). Applied only when it divides
+    ``Bf``; pass ``FIRM_CHUNK`` (the sampler rounds full-universe Bf to a
+    multiple of it) or None to disable.
     """
     fp = fp or xm.shape[-1]
     if not (_is_date_layout(firm_idx, time_idx) and xm.shape[1] >= window):
         return gather_windows(
             xm[..., :fp - 1], xm[..., fp - 1] != 0, firm_idx, time_idx,
             window)
+    D, bf = firm_idx.shape
+    if firm_chunk and bf > firm_chunk:
+        # Non-multiple widths (eval sweeps pad Bf to the raw max pool) are
+        # padded with firm-0 repeats and sliced back after — the bound on
+        # the row transient must hold for every caller, not just widths
+        # the sampler pre-rounded.
+        pad = -bf % firm_chunk
+        fi_p = (jnp.pad(firm_idx, ((0, 0), (0, pad))) if pad else firm_idx)
+        fi = fi_p.reshape(D, (bf + pad) // firm_chunk, firm_chunk)
+        fi = jnp.swapaxes(fi, 0, 1)  # [C, D, chunk]
+
+        def one(fic):
+            rows = xm[fic]  # [D, chunk, T, Fp]
+            return _slice_windows(
+                rows[..., :fp - 1], rows[..., fp - 1] != 0, time_idx,
+                window)
+
+        x, m = jax.lax.map(one, fi)  # [C, D, chunk, W, F], [C, D, chunk, W]
+        x = jnp.swapaxes(x, 0, 1).reshape(D, bf + pad, window, x.shape[-1])
+        m = jnp.swapaxes(m, 0, 1).reshape(D, bf + pad, window)
+        return x[:, :bf], m[:, :bf]
     rows = xm[firm_idx]  # [D, Bf, T, Fp] contiguous row gather
     return _slice_windows(
         rows[..., :fp - 1], rows[..., fp - 1] != 0, time_idx, window)

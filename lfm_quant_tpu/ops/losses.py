@@ -87,6 +87,8 @@ def rank_ic_loss(pred, target, w, temperature: float = 0.5):
     Target ranks use a small temperature (closer to hard ranks) since no
     gradient flows through the target side.
     """
+    pred = pred.astype(jnp.float32)  # ranks count to n; bf16's 8 mantissa
+    target = target.astype(jnp.float32)  # bits quantize ranks past n≈256
     pr = soft_rank(pred, w, temperature)
     tr = soft_rank(target, w, temperature=1e-3)
     ic = _center_corr(pr, tr, w.astype(pred.dtype))
@@ -137,6 +139,8 @@ def make_loss_parts(name: str):
         return nll_parts
     if name == "rank_ic":
         def rank_ic_parts(out, y, w, temperature=0.5):
+            out = out.astype(jnp.float32)  # see rank_ic_loss: full-universe
+            y = y.astype(jnp.float32)  # cross-sections overflow bf16 ranks
             pr = soft_rank(out, w, temperature)
             tr = soft_rank(y, w, temperature=1e-3)
             ic = _center_corr(pr, tr, w.astype(out.dtype))

@@ -375,8 +375,15 @@ class Trainer:
 
             return gather_windows_pallas(
                 xm, firm_idx, time_idx, self.window, fp=self._fp)
+        # Full-universe widths chunk the firm axis so the [D, Bf, T, F]
+        # row transient stays bounded (the Pallas DMA gather above never
+        # materializes rows, so it needs no chunking).
+        from lfm_quant_tpu.data.windows import FIRM_CHUNK
+
+        chunk = FIRM_CHUNK if firm_idx.shape[-1] >= 2 * FIRM_CHUNK else None
         return gather_windows_packed(
-            xm, firm_idx, time_idx, self.window, fp=self._fp)
+            xm, firm_idx, time_idx, self.window, fp=self._fp,
+            firm_chunk=chunk)
 
     def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
                    weight, axis: Optional[str] = None):

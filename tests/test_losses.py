@@ -176,3 +176,63 @@ def test_rank_ic_loss_ignores_padded_slots():
         rank_ic_loss(jnp.asarray(pred2), jnp.asarray(targ), jnp.asarray(w))
     )
     assert poisoned == pytest.approx(base, abs=1e-4)
+
+
+def _numpy_rank_ic(pred, target, w, temperature=0.5, tt=1e-3):
+    """Float64 numpy mirror of rank_ic_loss, chunked over rows so the
+    n² pairwise matrix never materializes whole."""
+    def srank(x, temp):
+        out = np.zeros_like(x, dtype=np.float64)
+        for d in range(x.shape[0]):
+            xi = x[d].astype(np.float64)
+            for lo in range(0, xi.size, 1000):
+                diff = (xi[lo:lo + 1000, None] - xi[None, :]) / temp
+                with np.errstate(over="ignore"):  # exp overflow → inf → p=0
+                    p = np.where(w[d][None, :] > 0,
+                                 1.0 / (1.0 + np.exp(-diff)), 0.0)
+                out[d, lo:lo + 1000] = p.sum(axis=1)
+        return out
+
+    pr, tr = srank(pred, temperature), srank(target, tt)
+    ics = []
+    for d in range(pred.shape[0]):
+        wd = w[d].astype(np.float64)
+        a = pr[d] - (pr[d] * wd).sum() / wd.sum()
+        b = tr[d] - (tr[d] * wd).sum() / wd.sum()
+        a, b = a * wd, b * wd
+        ics.append((a * b).sum() /
+                   max(np.sqrt((a * a).sum() * (b * b).sum()), 1e-8))
+    return -float(np.mean(ics))
+
+
+def test_rank_ic_loss_full_universe_n8000_matches_numpy():
+    """Pin the loss at c3's FULL-cross-section width (n=8000, the
+    full-universe training mode) against a float64 numpy mirror — the
+    f32 pairwise sums must hold up at 8000² pair counts."""
+    rng = np.random.default_rng(42)
+    n = 8000
+    pred = rng.standard_normal((1, n)).astype(np.float32)
+    target = (0.3 * pred + 0.7 *
+              rng.standard_normal((1, n))).astype(np.float32)
+    w = np.ones((1, n), np.float32)
+    w[0, -137:] = 0.0  # padded tail, as the full-universe sampler emits
+    got = float(jax.jit(rank_ic_loss)(pred, target, w))
+    want = _numpy_rank_ic(pred, target, w)
+    assert abs(got - want) < 2e-4, (got, want)
+    # parts must reassemble to the same value at this width too
+    num, den = jax.jit(make_loss_parts("rank_ic"))(pred, target, w)
+    assert abs(float(finalize_loss(num, den)) - want) < 2e-4
+
+
+def test_rank_ic_loss_bf16_inputs_upcast():
+    """bf16 model outputs must not quantize ranks: the loss upcasts, so
+    bf16 inputs give ≈ the f32 answer even at n >> 256."""
+    rng = np.random.default_rng(7)
+    n = 2048
+    pred = rng.standard_normal((2, n)).astype(np.float32)
+    target = rng.standard_normal((2, n)).astype(np.float32)
+    w = np.ones((2, n), np.float32)
+    f32 = float(rank_ic_loss(pred, target, w))
+    bf = float(rank_ic_loss(jnp.asarray(pred, jnp.bfloat16),
+                            jnp.asarray(target, jnp.bfloat16), w))
+    assert abs(f32 - bf) < 0.02, (f32, bf)

@@ -209,3 +209,29 @@ def test_bench_ladder_gather_override(monkeypatch):
     assert "scan_impl" not in cfg.model.kwargs  # lru: RNN-only knob
     cfg = bench_ladder._overrides(get_preset("c2"))
     assert cfg.model.kwargs["scan_impl"] == "pallas_fused"
+
+
+def test_full_universe_rank_ic_trains(panel, tmp_path):
+    """c3's training mode: firms_per_date=0 ranks each month's FULL
+    eligible cross-section. The planted signal must be recovered and the
+    sampler must report a rounded full-width Bf."""
+    cfg = tiny_cfg(
+        name="t_full_universe",
+        data=DataConfig(
+            n_firms=200, n_months=160, n_features=5, window=12,
+            dates_per_batch=4, firms_per_date=0,
+        ),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (32,)}),
+        optim=OptimConfig(lr=3e-3, epochs=4, warmup_steps=10,
+                          early_stop_patience=6, loss="rank_ic"),
+        out_dir=str(tmp_path),
+    )
+    summary, trainer, splits = run_experiment(cfg, panel=panel)
+    from lfm_quant_tpu.data import anchor_index
+    elig = anchor_index(splits.panel, trainer.window)
+    mx = max(int(elig[:, t].sum())
+             for t in trainer.train_sampler._dates)
+    assert trainer.train_sampler.firms_per_date >= mx
+    assert trainer.train_sampler.firms_per_date % 8 == 0
+    assert np.isfinite(summary["history"][-1]["train_loss"])
+    assert summary["best_val_ic"] > 0.1, summary["best_val_ic"]

@@ -206,3 +206,64 @@ def test_gather_windows_packed_matches_general(panel):
     np.testing.assert_array_equal(np.asarray(mg), np.asarray(mb))
     np.testing.assert_allclose(np.asarray(xb).astype(np.float32),
                                np.asarray(xg), rtol=1e-2, atol=1e-2)
+
+
+def test_full_universe_sampler(panel):
+    """firms_per_date=0: every batch row carries the date's ENTIRE eligible
+    pool (set equality with the anchor index), padded to a static rounded
+    Bf with weight 0."""
+    s = DateBatchSampler(panel, WINDOW, dates_per_batch=4, firms_per_date=0,
+                         seed=5)
+    elig = anchor_index(panel, WINDOW)
+    mx = max(int(elig[:, t].sum()) for t in s._dates)
+    assert s.firms_per_date == -(-mx // 8) * 8  # rounded max (small panel)
+    for b in s.epoch(0):
+        for j in range(4):
+            t = int(b.time_idx[j])
+            pool = set(np.nonzero(elig[:, t])[0].tolist())
+            real = b.firm_idx[j][b.weight[j] > 0]
+            assert set(real.tolist()) == pool  # full universe, exactly once
+            assert len(np.unique(real)) == len(real)
+            # padding (if any) is weight-0 repetition of pool members
+            pad = b.firm_idx[j][b.weight[j] == 0]
+            assert set(pad.tolist()) <= pool
+
+
+def test_full_universe_rounds_to_chunk_multiple():
+    """Above 2×FIRM_CHUNK eligible firms, full-universe Bf rounds to a
+    FIRM_CHUNK multiple so the chunked gather divides evenly."""
+    from lfm_quant_tpu.data.windows import FIRM_CHUNK
+
+    big = synthetic_panel(n_firms=2600, n_months=100, n_features=3, seed=3,
+                          min_history=24)
+    s = DateBatchSampler(big, 12, dates_per_batch=2, firms_per_date=0,
+                         seed=0)
+    assert s.firms_per_date % FIRM_CHUNK == 0
+    assert s.firms_per_date >= max(
+        int(anchor_index(big, 12)[:, t].sum()) for t in s._dates)
+
+
+def test_gather_firm_chunked_matches_unchunked(panel):
+    """firm_chunk must be a pure memory-shape knob: identical output."""
+    from lfm_quant_tpu.data import gather_windows_packed
+
+    dev = device_panel(panel)
+    rng = np.random.default_rng(8)
+    fi = rng.integers(0, panel.n_firms, size=(3, 64)).astype(np.int32)
+    ti = rng.integers(WINDOW, panel.n_months, size=(3,)).astype(np.int32)
+    x0, m0 = jax.jit(gather_windows_packed, static_argnames="window")(
+        dev["xm"], jnp.asarray(fi), jnp.asarray(ti), window=WINDOW)
+    xc, mc = jax.jit(gather_windows_packed,
+                     static_argnames=("window", "firm_chunk"))(
+        dev["xm"], jnp.asarray(fi), jnp.asarray(ti), window=WINDOW,
+        firm_chunk=16)
+    np.testing.assert_array_equal(np.asarray(m0), np.asarray(mc))
+    np.testing.assert_array_equal(np.asarray(x0), np.asarray(xc))
+    # Non-multiple width (eval sweeps pad Bf to the raw max pool): the
+    # chunked path pads internally and slices back — still identical.
+    xn, mn = jax.jit(gather_windows_packed,
+                     static_argnames=("window", "firm_chunk"))(
+        dev["xm"], jnp.asarray(fi[:, :50]), jnp.asarray(ti), window=WINDOW,
+        firm_chunk=16)
+    np.testing.assert_array_equal(np.asarray(m0[:, :50]), np.asarray(mn))
+    np.testing.assert_array_equal(np.asarray(x0[:, :50]), np.asarray(xn))
