@@ -11,6 +11,8 @@ from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
 from lfm_quant_tpu.data import synthetic_panel
 from lfm_quant_tpu.data.panel import Panel
 
+pytestmark = pytest.mark.fast  # whole module is smoke-lane cheap
+
 
 def toy_panel(n=10, t=36, seed=0):
     """Minimal hand-controllable panel: all firms always valid."""

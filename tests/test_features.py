@@ -13,6 +13,8 @@ from lfm_quant_tpu.data.features import (
     standardize_column,
 )
 
+pytestmark = pytest.mark.fast  # whole module is smoke-lane cheap
+
 
 @pytest.fixture(scope="module")
 def panel():

@@ -22,6 +22,8 @@ from lfm_quant_tpu.ops import (
     spearman_ic,
 )
 
+pytestmark = pytest.mark.fast  # whole module is smoke-lane cheap
+
 
 @pytest.mark.parametrize("name", ["mse", "huber", "rank_ic", "nll"])
 def test_loss_parts_reassemble_exactly(name):
@@ -205,6 +207,7 @@ def _numpy_rank_ic(pred, target, w, temperature=0.5, tt=1e-3):
     return -float(np.mean(ics))
 
 
+@pytest.mark.slow  # ~1 min of 8000² pairwise sums on CPU
 def test_rank_ic_loss_full_universe_n8000_matches_numpy():
     """Pin the loss at c3's FULL-cross-section width (n=8000, the
     full-universe training mode) against a float64 numpy mirror — the

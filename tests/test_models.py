@@ -31,6 +31,7 @@ def init_and_apply(kind, x, m, **kw):
 
 
 @pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.fast
 def test_forward_shape_and_dtype(kind):
     x, m = make_batch()
     _, _, y = init_and_apply(kind, x, m)
@@ -99,6 +100,7 @@ def test_grad_flows_and_is_finite(kind):
 
 
 @pytest.mark.parametrize("kind", KINDS)
+@pytest.mark.fast
 def test_jit_matches_eager(kind):
     x, m = make_batch()
     model, params, y = init_and_apply(kind, x, m)
@@ -131,6 +133,7 @@ def test_rnn_multilayer():
     assert bool(jnp.isfinite(y).all())
 
 
+@pytest.mark.fast
 def test_unknown_kind_raises():
     with pytest.raises(ValueError, match="unknown model kind"):
         build_model("resnet")

@@ -26,6 +26,7 @@ def csv_path(tmp_path_factory):
     return str(path)
 
 
+@pytest.mark.fast
 def test_csv_engines_identical(csv_path):
     a = load_compustat_csv(csv_path, engine="pandas")
     b = load_compustat_csv(csv_path, engine="native")
@@ -163,6 +164,7 @@ def sampler_pair():
     return mk("python"), mk("native")
 
 
+@pytest.mark.fast
 def test_native_sampler_structure(sampler_pair):
     py, nat = sampler_pair
     assert nat.batches_per_epoch() == py.batches_per_epoch()

@@ -5,6 +5,8 @@ import pytest
 
 from lfm_quant_tpu.data import Panel, PanelSplits, load_panel, synthetic_panel
 
+pytestmark = pytest.mark.fast  # whole module is smoke-lane cheap
+
 
 @pytest.fixture(scope="module")
 def panel():

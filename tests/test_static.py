@@ -15,6 +15,10 @@ import typing
 
 import lfm_quant_tpu
 
+import pytest
+
+pytestmark = pytest.mark.fast  # whole module is smoke-lane cheap
+
 
 def _walk_modules():
     yield lfm_quant_tpu

@@ -41,6 +41,7 @@ def fitted(panel, tmp_path_factory):
     return cfg, summary, trainer, splits
 
 
+@pytest.mark.fast
 def test_loss_decreases(fitted):
     _, summary, _, _ = fitted
     hist = summary["history"]
@@ -49,6 +50,7 @@ def test_loss_decreases(fitted):
     assert last < first * 0.9, f"train loss did not decrease: {first} -> {last}"
 
 
+@pytest.mark.fast
 def test_recovers_planted_signal(fitted):
     """Val Spearman IC must be materially positive — the planted signal is
     forecastable, so a working pipeline must find it."""
@@ -56,6 +58,7 @@ def test_recovers_planted_signal(fitted):
     assert summary["best_val_ic"] > 0.15, summary["best_val_ic"]
 
 
+@pytest.mark.fast
 def test_metrics_logged(fitted):
     import json, os
     _, summary, _, _ = fitted
@@ -133,6 +136,7 @@ def test_early_stopping_triggers(panel, tmp_path):
     assert summary["epochs_run"] <= 4
 
 
+@pytest.mark.fast
 def test_make_loss_fn_rejects_unknown():
     with pytest.raises(ValueError, match="unknown loss"):
         make_loss_fn("hinge")

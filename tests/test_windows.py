@@ -15,6 +15,8 @@ from lfm_quant_tpu.data import (
 )
 from lfm_quant_tpu.data.windows import rolling_valid_count
 
+pytestmark = pytest.mark.fast  # whole module is smoke-lane cheap
+
 WINDOW = 24
 
 
