@@ -172,7 +172,10 @@ def bench_c5_ensemble() -> None:
 
     cfg = _scan_impl_override(get_preset("c5"))
     n_seeds = int(os.environ.get("LFM_BENCH_SEEDS", "16"))
-    cfg = _dc.replace(cfg, n_seeds=n_seeds)
+    # LFM_BENCH_SEED_BLOCK: scan the seed stack in blocks of this size
+    # (HBM-fit fallback for the full 64-seed stack on one chip).
+    seed_block = int(os.environ.get("LFM_BENCH_SEED_BLOCK", "0"))
+    cfg = _dc.replace(cfg, n_seeds=n_seeds, seed_block=seed_block)
     d = cfg.data
     # Full c5 firm cross-section (8000) and feature/window geometry;
     # months trimmed (throughput is O(batch), not O(panel), once the

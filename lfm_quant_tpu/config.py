@@ -95,6 +95,14 @@ class RunConfig:
     seed: int = 0
     n_seeds: int = 1  # >1 → ensemble (vmapped replicas)
     n_data_shards: int = 1  # data-parallel axis size
+    # Seed microbatching: >0 scans the (per-device) seed stack in blocks
+    # of this size inside the train step, bounding activation memory to
+    # seed_block × per-seed instead of all resident seeds at once — the
+    # HBM-fit fallback for wide ensembles (e.g. 64 seeds on one chip).
+    # 0 = all local seeds in one vmapped step. Must divide the per-shard
+    # seed count. Trades step-level parallelism for memory; throughput is
+    # unchanged when the per-block batch already fills the chip.
+    seed_block: int = 0
     out_dir: str = "runs"
 
     @property
@@ -118,6 +126,7 @@ class RunConfig:
             seed=raw.get("seed", 0),
             n_seeds=raw.get("n_seeds", 1),
             n_data_shards=raw.get("n_data_shards", 1),
+            seed_block=raw.get("seed_block", 0),
             out_dir=raw.get("out_dir", "runs"),
         )
 

@@ -85,6 +85,21 @@ class EnsembleTrainer:
             if n_seed_mesh * n_data > 1 else None
         )
 
+        self.seed_block = int(getattr(cfg, "seed_block", 0) or 0)
+        if self.seed_block < 0:
+            raise ValueError(f"seed_block must be >= 0, got {self.seed_block}")
+        local_seeds = self.n_seeds // n_seed_mesh
+        # A block >= the per-shard count is a no-op (the step degrades to
+        # the unblocked vmap), NOT an error: a config tuned for one chip
+        # (e.g. seed_block=8 at 64 local seeds) must stay loadable on a
+        # wider seed mesh where local_seeds shrinks below the block.
+        if (0 < self.seed_block < local_seeds
+                and local_seeds % self.seed_block):
+            raise ValueError(
+                f"seed_block={self.seed_block} must divide the per-shard "
+                f"seed count {local_seeds} (n_seeds={self.n_seeds} over a "
+                f"{n_seed_mesh}-wide seed mesh)")
+
         # The single-seed Trainer provides the model, loss, optimizer,
         # jit-free step/forward impls that we vmap, AND the HBM-resident
         # panel (ONE copy serves ensemble + inner: PanelSplits are anchor
@@ -114,7 +129,7 @@ class EnsembleTrainer:
         if self.mesh is None:
             self._vstep = jax.vmap(
                 self.inner._step_impl, in_axes=(0, None, 0, 0, 0))
-            self._jit_step = jax.jit(self._vstep)
+            self._jit_step = jax.jit(self._step_shards)
             self._jit_multi_step = jax.jit(self._multi_step_impl)
         else:
             self._vstep = jax.vmap(
@@ -134,7 +149,35 @@ class EnsembleTrainer:
             in_axes=(0, None, None, None, None)))
 
     def _step_shards(self, state, dev, fi, ti, w):
-        return self._vstep(state, dev, fi, ti, w)
+        """One ensemble step over the LOCAL seed stack (the whole stack
+        off-mesh; the shard's block under shard_map).
+
+        With ``seed_block`` set, the local stack is stepped in blocks via
+        ``lax.scan`` — peak activation memory drops from all-local-seeds ×
+        per-seed to seed_block × per-seed (params/opt stay resident either
+        way), which is what lets a 64-seed c5 train on a single chip when
+        the vmapped backward doesn't fit HBM. Seeds are independent, so
+        blocking is numerically a pure re-batching."""
+        blk = self.seed_block
+        s_local = fi.shape[0]
+        if not blk or blk >= s_local:
+            return self._vstep(state, dev, fi, ti, w)
+        nb = s_local // blk
+
+        def to_blocks(t):
+            return jax.tree.map(
+                lambda x: x.reshape((nb, blk) + x.shape[1:]), t)
+
+        def body(_, xs):
+            st, f, t, ww = xs
+            return None, self._vstep(st, dev, f, t, ww)
+
+        _, (new_state, ms) = jax.lax.scan(
+            body, None, (to_blocks(state), to_blocks(fi), to_blocks(ti),
+                         to_blocks(w)))
+        unblock = lambda t: jax.tree.map(
+            lambda x: x.reshape((s_local,) + x.shape[2:]), t)
+        return unblock(new_state), unblock(ms)
 
     def _shard_mapped(self, impl, steps_axis: bool):
         """shard_map an ensemble step over (seed × data): the stacked
@@ -160,7 +203,7 @@ class EnsembleTrainer:
         """K vmapped ensemble steps in one dispatch: lax.scan over a
         [K, S, D, Bf] index stack (see Trainer._multi_step_impl)."""
         def body(st, batch):
-            return self._vstep(st, dev, *batch)
+            return self._step_shards(st, dev, *batch)
 
         return jax.lax.scan(body, state, (fi, ti, w))
 

@@ -1,0 +1,105 @@
+"""Pod-shape dry runs: the 64-seed ensemble axis at its REAL width on 64
+virtual CPU devices (BASELINE.json:11 — the c5 64-seed geometry), without
+a pod. Subprocesses because the device count must be fixed before backend
+init (conftest pins the main process to 8).
+
+Covers: 64×1 seed mesh and 8×8 seed×data mesh construction, the stacked
+64-seed train state sharded over each, one train step + eval, and a
+stacked-checkpoint save/restore at pod shape.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.slow  # ~1 min: two 64-device subprocesses
+
+_POD = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 64)
+    import numpy as np
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+    from lfm_quant_tpu.train.loop import TrainState, restore_state_dict
+    from lfm_quant_tpu.train.checkpoint import CheckpointManager
+
+    assert jax.device_count() == 64
+    panel = synthetic_panel(n_firms=96, n_months=120, n_features=4, seed=0,
+                            min_history=60)
+    splits = PanelSplits.by_date(panel, 197706, 197901)
+
+    def run(n_seeds, n_data, tag):
+        cfg = RunConfig(
+            name=f"pod_{tag}",
+            data=DataConfig(n_firms=96, n_months=120, n_features=4,
+                            window=8, dates_per_batch=max(2, n_data),
+                            firms_per_date=8),
+            model=ModelConfig(kind="lstm", kwargs={"hidden": 8}),
+            optim=OptimConfig(lr=1e-3, epochs=1, warmup_steps=1,
+                              loss="mse"),
+            n_seeds=n_seeds, n_data_shards=n_data,
+        )
+        tr = EnsembleTrainer(cfg, splits)
+        assert tr.mesh is not None
+        assert dict(tr.mesh.shape) == {"seed": min(n_seeds, 64 // n_data),
+                                       "data": n_data}, tr.mesh.shape
+        state = tr.init_state()
+        # The stacked state's seed axis must actually shard over the mesh:
+        # spec pins axis 0 to 'seed', and the leaf spans the full mesh
+        # (sharded over seed, replicated over data).
+        leaf = jax.tree.leaves(state.params)[0]
+        assert leaf.sharding.spec[0] == "seed", (tag, leaf.sharding)
+        assert len(leaf.sharding.device_set) == tr.mesh.devices.size, (
+            tag, leaf.sharding)
+        arrays = tr._stacked_batch([s.epoch(0) for s in tr.samplers])
+        state, ms = tr._jit_step(state, tr.dev, *arrays)
+        loss = float(np.asarray(ms["loss"]).mean())
+        assert np.isfinite(loss), (tag, loss)
+        val = tr.evaluate(state.params)
+        assert np.isfinite(val["ic_mean"]), tag
+        print(f"{tag} OK mesh={dict(tr.mesh.shape)} loss={loss:.4f}",
+              flush=True)
+        return tr, state
+
+    # 64-wide seed mesh: one member per device — the c5 pod layout.
+    tr64, state64 = run(64, 1, "seed64x1")
+    # 8 x 8 two-axis mesh: 8-seed blocks x 8-way data parallelism.
+    run(8, 8, "seed8x8")
+
+    # Stacked checkpoint at pod width: save the 64-seed state, restore,
+    # re-place on the mesh, and step again. Written under the cwd (the
+    # pytest tmp_path) so the run leaves nothing behind.
+    import os
+    mgr = CheckpointManager(os.path.abspath("ck"))
+    mgr.save(1, state64._asdict(), wait=True)
+    restored = restore_state_dict(mgr, tr64.init_state()._asdict())
+    mgr.close()
+    rstate = tr64._commit_state(TrainState(**restored))
+    for a, b in zip(jax.tree.leaves(state64.params),
+                    jax.tree.leaves(rstate.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    arrays = tr64._stacked_batch([s.epoch(1) for s in tr64.samplers])
+    rstate, ms = tr64._jit_step(rstate, tr64.dev, *arrays)
+    assert np.isfinite(float(np.asarray(ms["loss"]).mean()))
+    print("ckpt64 OK", flush=True)
+""")
+
+
+def test_pod_shape_64_devices(tmp_path):
+    """The 64-seed axis at 64: meshes, sharded stacked state, step, eval,
+    checkpoint roundtrip — all at the c5 pod's real seed width."""
+    script = tmp_path / "pod.py"
+    script.write_text(_POD)
+    proc = subprocess.run(
+        [sys.executable, str(script)], cwd=str(tmp_path),
+        env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": ":".join(sys.path)},
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for tag in ("seed64x1 OK", "seed8x8 OK", "ckpt64 OK"):
+        assert tag in proc.stdout, proc.stdout
