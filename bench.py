@@ -126,6 +126,41 @@ def measure_ensemble_trainer(trainer, k: int = 10, reps: int = 3) -> float:
     return fm / dt
 
 
+def measure_eval(trainer, reps: int = 5) -> float:
+    """Inference/backtest-path throughput (firm-months/sec): the stacked
+    cross-section eval sweep — EVERY val month's full cross-section in one
+    dispatch, the same forward the backtest's predict path uses
+    (SURVEY.md §4.3). Works for both Trainer ([M, bf] batch) and
+    EnsembleTrainer (seed-vmapped forward; firm-months counted across the
+    whole seed stack — per-chip ensemble inference). Sync discipline
+    matches measure_trainer: scalar readback, not block_until_ready."""
+    import numpy as np
+
+    state = getattr(trainer, "state", None)
+    params = state.params if state is not None else trainer.init_state().params
+    b = trainer.val_sampler.stacked_cross_sections()
+    # EnsembleTrainer delegates batch prep to its inner Trainer.
+    fi, ti, w = getattr(trainer, "inner", trainer)._batch_args(b)
+    fm = (float(b.weight.sum()) * trainer.window
+          * getattr(trainer, "n_seeds", 1))
+
+    def sync(pred):
+        return float(np.asarray(pred).ravel()[0])  # true device sync
+
+    pred, _, _ = trainer._jit_forward(params, trainer.dev, fi, ti, w)
+    sync(pred)  # warmup: compile + one full pass
+
+    # Dispatches queue back-to-back; ONE readback at the end forces the
+    # whole pipeline (per-dispatch sync would add ~25-30 ms of tunnel
+    # latency to every rep — see measure_trainer).
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        pred, _, _ = trainer._jit_forward(params, trainer.dev, fi, ti, w)
+    sync(pred)
+    dt = (time.perf_counter() - t0) / reps
+    return fm / dt
+
+
 def _scan_impl_override(cfg):
     """LFM_BENCH_SCAN_IMPL=xla|pallas|pallas_fused overrides the RNN scan
     implementation — the on-chip validation/measurement hook for kernel

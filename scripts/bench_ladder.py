@@ -27,6 +27,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import (  # noqa: E402
     V5E_BF16_PEAK,
     measure_ensemble_trainer,
+    measure_eval,
     measure_trainer,
 )
 
@@ -119,7 +120,15 @@ def _overrides(cfg):
     return cfg
 
 
-def bench_config(name: str) -> dict:
+def bench_config(name: str):
+    """Yield train then eval throughput records for one ladder config.
+
+    A GENERATOR so the train record reaches the caller (and stdout)
+    before the eval sweep runs — a tunnel death or OOM mid-eval must not
+    discard an already-measured train number from a scarce chip session.
+    Eval is the inference/backtest half of the workflow (SURVEY.md §4.3):
+    the stacked full-cross-section sweep; its analytic MFU uses
+    forward-only FLOPs (1/3 of the 3× fwd+bwd training count)."""
     from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.train import Trainer
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
@@ -127,38 +136,56 @@ def bench_config(name: str) -> dict:
     cfg = _overrides(get_preset(name))
     _log(f"{name}: building panel")
     splits = _bench_panel(cfg)
+    extras = {}
     if cfg.n_seeds > 1:
         n_seeds = int(os.environ.get("LFM_BENCH_SEEDS", "16"))
-        cfg = dataclasses.replace(cfg, n_seeds=n_seeds)
+        seed_block = int(os.environ.get("LFM_BENCH_SEED_BLOCK", "0"))
+        cfg = dataclasses.replace(cfg, n_seeds=n_seeds,
+                                  seed_block=seed_block)
+        extras["n_seeds"] = n_seeds
+        if seed_block:  # record the memory/throughput trade-off knob
+            extras["seed_block"] = seed_block
         _log(f"{name}: building EnsembleTrainer ({n_seeds} seeds)")
         trainer = EnsembleTrainer(cfg, splits)
-        _log(f"{name}: measuring (compile on first dispatch)")
+        _log(f"{name}: measuring train (compile on first dispatch)")
         value = measure_ensemble_trainer(
             trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10")))
     else:
         _log(f"{name}: building Trainer")
         trainer = Trainer(cfg, splits)
-        _log(f"{name}: gather={trainer._gather_impl}; measuring "
+        _log(f"{name}: gather={trainer._gather_impl}; measuring train "
              "(compile on first dispatch)")
         value = measure_trainer(
             trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "30")))
-    _log(f"{name}: done")
     flops = _flops_per_fm(cfg)
-    return {
+    yield {
         "metric": f"train_throughput_{name}",
         "value": round(value, 1),
         "unit": "firm-months/sec/chip",
         "mfu_pct": round(100.0 * value * flops / V5E_BF16_PEAK, 2),
         "config": cfg.name,
         "loss": cfg.optim.loss,
+        **extras,
+    }
+    _log(f"{name}: measuring eval sweep")
+    eval_value = measure_eval(trainer)
+    _log(f"{name}: done")
+    yield {
+        "metric": f"eval_throughput_{name}",
+        "value": round(eval_value, 1),
+        "unit": "firm-months/sec/chip",
+        "mfu_pct": round(100.0 * eval_value * (flops / 3.0)
+                         / V5E_BF16_PEAK, 2),
+        "config": cfg.name,
+        **extras,
     }
 
 
 def main(argv) -> int:
     names = argv or ["c1", "c2", "c3", "c4", "c5", "lru"]
     for name in names:
-        rec = bench_config(name)
-        print(json.dumps(rec), flush=True)
+        for rec in bench_config(name):
+            print(json.dumps(rec), flush=True)
     return 0
 
 
