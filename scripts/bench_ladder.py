@@ -117,6 +117,17 @@ def _overrides(cfg):
     if gi:
         cfg = dataclasses.replace(
             cfg, data=dataclasses.replace(cfg.data, gather_impl=gi))
+    # LFM_BENCH_DATES: dates per batch on THIS device. The sharded
+    # configs (c3: 8-way, c4: 16-way) degrade to the one visible chip;
+    # their real per-shard batch is dates_per_batch / n_shards, and at
+    # c3's full-universe width (Bf ≈ 8192) the full-D batch may not fit
+    # one chip's HBM even though the per-shard batch does.
+    dates = os.environ.get("LFM_BENCH_DATES")
+    if dates:
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data,
+                                          dates_per_batch=int(dates)),
+            n_data_shards=1)
     return cfg
 
 
@@ -133,10 +144,15 @@ def bench_config(name: str):
     from lfm_quant_tpu.train import Trainer
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
 
-    cfg = _overrides(get_preset(name))
+    preset = get_preset(name)
+    cfg = _overrides(preset)
     _log(f"{name}: building panel")
     splits = _bench_panel(cfg)
     extras = {}
+    if cfg.data.dates_per_batch != preset.data.dates_per_batch:
+        # LFM_BENCH_DATES was applied: the record must say which batch
+        # geometry it measured (per-shard vs full-D are different rows).
+        extras["dates_per_batch"] = cfg.data.dates_per_batch
     if cfg.n_seeds > 1:
         n_seeds = int(os.environ.get("LFM_BENCH_SEEDS", "16"))
         seed_block = int(os.environ.get("LFM_BENCH_SEED_BLOCK", "0"))

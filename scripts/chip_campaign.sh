@@ -1,9 +1,14 @@
 #!/bin/bash
 # On-chip measurement campaign — fills BASELINE.md's pending ladder rows
 # after a tunnel outage (see BASELINE.md's 2026-07-30 note). Ordered so a
-# re-wedge loses the least: driver metrics first, the c1 suspect LAST.
-# Every step is timeboxed and logged; a timeout on a non-c1 step means
-# the tunnel wedged again and the campaign aborts.
+# re-wedge loses the least: driver metrics first, then the unmeasured
+# ladder rows (each now also records an eval_throughput row), the 64-seed
+# HBM-fit probe, the block-size sweep, and the c1 suspect LAST.
+# Every step is timeboxed and logged; a timeout on a non-risky step means
+# the tunnel wedged again and the campaign aborts. After every RISKY step
+# a cheap probe re-checks the tunnel — a killed client is the documented
+# server-side wedge trigger, and without the probe a wedge caused by one
+# risky step would silently corrupt every later (no-abort) step.
 #
 # Usage: bash scripts/chip_campaign.sh [logfile]
 cd "$(dirname "$0")/.."
@@ -17,7 +22,7 @@ step() {
   rc=$?
   echo "--- $name rc=$rc" | tee -a "$LOG"
   case "$name" in
-    c1*) ;;  # expected-risky steps don't abort the campaign
+    c1diag*|seeds64*|sweep*|c3-fullD) ;;  # expected-risky: don't abort
     *) if [ $rc -ne 0 ]; then
          echo "!!! $name failed — aborting (tunnel may be wedged)" | tee -a "$LOG"
          exit $rc
@@ -25,20 +30,49 @@ step() {
   esac
 }
 
-TMO=120 step probe python -c "
+probe() {
+  TMO=120 step "probe-$1" python -c "
 import jax, jax.numpy as jnp
 print('TUNNEL_OK', float(jax.jit(lambda a: a@a)(jnp.ones((256,256), jnp.bfloat16)).sum()))"
+}
 
+probe start
+
+# Driver metrics first: c2 + c5@16 re-verified with the fused kernel.
 TMO=600 step bench python bench.py
-TMO=600 step ladder-c3 python scripts/bench_ladder.py c3
-TMO=600 step ladder-c4 python scripts/bench_ladder.py c4
+
+# Unmeasured ladder rows (train + eval records each). c3 now trains
+# full-universe rank-IC (Bf ≈ 8192) — watch HBM; c2's eval row rides on
+# the ladder too.
+TMO=600 step ladder-c2 python scripts/bench_ladder.py c2
+# c3 at the REAL per-shard batch (8-way date sharding → D=1 per chip);
+# the full-D single-chip variant follows as a risky extra (OOM risk).
+TMO=900 step ladder-c3 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c3
+TMO=900 step c3-fullD python scripts/bench_ladder.py c3
+probe after-c3
+TMO=600 step ladder-c4 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c4
 TMO=600 step ladder-lru python scripts/bench_ladder.py lru
 TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
+
+# The 64-seed axis at 64 on one chip (BASELINE.json:11): first the full
+# vmapped stack; if HBM refuses, the seed-microbatched fallback at
+# block 16. Risky by design — does not abort the campaign.
+TMO=900 step seeds64-full env LFM_BENCH_SEEDS=64 python scripts/bench_ladder.py c5
+probe after-seeds64
+TMO=900 step seeds64-blocked env LFM_BENCH_SEEDS=64 LFM_BENCH_SEED_BLOCK=16 \
+  python scripts/bench_ladder.py c5
+probe after-seeds64b
+
+# Block-size sweep for the fused recurrence (DESIGN.md §8's bb lever).
+TMO=900 step sweep-blocks python scripts/sweep_rnn_blocks.py
+probe after-sweep
 
 # The c1 suspect, isolated and LAST (see scripts/diag_c1.py): first the
 # XLA gather (rules out the MLP program), then the Pallas DMA gather.
 TMO=420 step c1diag-xla python scripts/diag_c1.py xla 5
+probe after-c1diag-xla
 TMO=420 step c1diag-pallas python scripts/diag_c1.py - 5
+probe after-c1diag-pallas
 TMO=600 step c1 python scripts/bench_ladder.py c1
 
 echo "=== campaign done $(date) ===" | tee -a "$LOG"

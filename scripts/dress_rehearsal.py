@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Real-data dress rehearsal: the DOCUMENTED CSV path end-to-end at c5
+scale (SURVEY.md §4.4; README "Real data").
+
+Stages, each wall-clocked and printed as one JSON line at the end:
+
+  1. synthesize  — c5-sized panel (~8000 firms × 660 months × 20 features)
+  2. export      — to_long_frame → CSV (the documented long schema)
+  3. parse_native / parse_pandas — load_compustat_csv with each engine on
+     the SAME file, equality-checked; the measured pair substantiates the
+     "~2× faster than pandas" claim in data/compustat.py
+  4. walkforward — train.py --config (panel_path=CSV, target_col, derived
+     features) --walk-forward: the real CLI, stitching OOS forecasts
+  5. backtest    — backtest.py --forecast-npz ... --yearly
+
+Default geometry is the full c5 panel; training cost is controlled by
+--epochs/--wf-folds so the rehearsal is feasible on CPU (full-depth
+training is a chip job — pass --epochs/--wf-folds higher there). Use
+--scale to shrink the panel itself for smoke runs.
+
+Run: python scripts/dress_rehearsal.py [--scale 1.0] [--epochs 2]
+     [--wf-folds 2] [--keep]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _log(msg):
+    print(f"[dress] {msg}", file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="shrink firms/months by this factor (smoke runs)")
+    ap.add_argument("--epochs", type=int, default=2,
+                    help="epochs per walk-forward fold")
+    ap.add_argument("--wf-folds", type=int, default=2,
+                    help="number of walk-forward folds")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the work dir (default: delete)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.data.compustat import load_compustat_csv, to_long_frame
+
+    n_firms = max(200, int(8000 * args.scale))
+    n_months = max(120, int(660 * args.scale))
+    work = tempfile.mkdtemp(prefix="dress_")
+    stages = {}
+
+    t0 = time.perf_counter()
+    panel = synthetic_panel(n_firms=n_firms, n_months=n_months,
+                            n_features=20, start_yyyymm=197001, horizon=12,
+                            seed=0)
+    stages["synthesize_s"] = round(time.perf_counter() - t0, 2)
+    _log(f"panel {n_firms}×{n_months}×20 in {stages['synthesize_s']}s "
+         f"({panel.valid.sum():,} firm-months)")
+
+    csv_path = os.path.join(work, "panel.csv")
+    t0 = time.perf_counter()
+    to_long_frame(panel).to_csv(csv_path, index=False)
+    stages["export_s"] = round(time.perf_counter() - t0, 2)
+    stages["csv_mb"] = round(os.path.getsize(csv_path) / 1e6, 1)
+    _log(f"CSV {stages['csv_mb']} MB in {stages['export_s']}s")
+
+    # Parser-only comparison (the "~2×" claim in data/compustat.py is
+    # about the parse itself; load_compustat_csv also grids + winsorizes,
+    # identical work for both engines, which dilutes the ratio).
+    from lfm_quant_tpu.data.compustat import _parse_native, _parse_pandas
+
+    t0 = time.perf_counter()
+    raw_native = _parse_native(csv_path, None)
+    stages["parse_only_native_s"] = (round(time.perf_counter() - t0, 2)
+                                     if raw_native is not None else None)
+    t0 = time.perf_counter()
+    _parse_pandas(csv_path, None)
+    stages["parse_only_pandas_s"] = round(time.perf_counter() - t0, 2)
+    if raw_native is not None:
+        stages["parse_only_speedup"] = round(
+            stages["parse_only_pandas_s"] / stages["parse_only_native_s"],
+            2)
+        _log(f"parse-only: native {stages['parse_only_native_s']}s vs "
+             f"pandas {stages['parse_only_pandas_s']}s "
+             f"({stages['parse_only_speedup']}×)")
+
+    loaded = {}
+    for engine in ("native", "pandas"):
+        t0 = time.perf_counter()
+        try:
+            loaded[engine] = load_compustat_csv(csv_path, horizon=12,
+                                                engine=engine)
+            stages[f"load_{engine}_s"] = round(time.perf_counter() - t0, 2)
+            _log(f"load[{engine}] {stages[f'load_{engine}_s']}s")
+        except RuntimeError as e:  # no native toolchain — record and go on
+            stages[f"load_{engine}_s"] = None
+            _log(f"load[{engine}] unavailable: {e}")
+    if len(loaded) == 2:
+        a, b = loaded["native"], loaded["pandas"]
+        np.testing.assert_array_equal(a.valid, b.valid)
+        np.testing.assert_allclose(a.features, b.features, atol=2e-6)
+        stages["load_speedup"] = round(
+            stages["load_pandas_s"] / stages["load_native_s"], 2)
+        _log(f"engines identical; end-to-end load speedup "
+             f"{stages['load_speedup']}×")
+
+    # Walk-forward through the REAL CLI on the CSV path with derived
+    # features — the documented real-data recipe.
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    cfg = RunConfig(
+        name="dress",
+        data=DataConfig(
+            panel_path=csv_path, horizon=12, window=60,
+            dates_per_batch=8, firms_per_date=256,
+            derived_features=("mom_12_1", "vol_12"),
+        ),
+        model=ModelConfig(kind="lstm", kwargs={"hidden": 128}, bf16=True),
+        optim=OptimConfig(lr=1e-3, epochs=args.epochs, warmup_steps=20,
+                          loss="mse"),
+        out_dir=os.path.join(work, "runs"),
+    )
+    cfg_path = os.path.join(work, "cfg.json")
+    with open(cfg_path, "w") as fh:
+        fh.write(cfg.to_json())
+
+    import backtest as backtest_cli
+    import train as train_cli
+
+    t0 = time.perf_counter()
+    rc = train_cli.main(["--config", cfg_path, "--walk-forward", "60",
+                         "--wf-folds", str(args.wf_folds), "--echo"])
+    stages["walkforward_s"] = round(time.perf_counter() - t0, 2)
+    if rc not in (0, None):
+        _log(f"walk-forward FAILED rc={rc}; work dir kept for debugging: "
+             f"{work}")
+        return 1
+    _log(f"walk-forward ({args.wf_folds} folds × {args.epochs} epochs) "
+         f"in {stages['walkforward_s']}s")
+
+    npz = os.path.join(cfg.out_dir, "dress", "wf", "walkforward.npz")
+    t0 = time.perf_counter()
+    rc = backtest_cli.main(["--forecast-npz", npz, "--yearly"])
+    stages["backtest_s"] = round(time.perf_counter() - t0, 2)
+    if rc not in (0, None):
+        _log(f"backtest FAILED rc={rc}; work dir kept for debugging: "
+             f"{work}")
+        return 1
+
+    import jax
+    stages.update(n_firms=n_firms, n_months=n_months,
+                  backend=jax.default_backend())
+    print(json.dumps({"metric": "dress_rehearsal", **stages}), flush=True)
+    if not args.keep:
+        shutil.rmtree(work, ignore_errors=True)
+    else:
+        _log(f"kept {work}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

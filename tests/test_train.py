@@ -239,3 +239,23 @@ def test_full_universe_rank_ic_trains(panel, tmp_path):
     assert trainer.train_sampler.firms_per_date % 8 == 0
     assert np.isfinite(summary["history"][-1]["train_loss"])
     assert summary["best_val_ic"] > 0.1, summary["best_val_ic"]
+
+
+def test_bench_ladder_dates_override(monkeypatch):
+    """LFM_BENCH_DATES must set the on-device dates_per_batch and drop the
+    data-shard count to 1 — the per-shard-batch hook for benching sharded
+    configs on the one visible chip."""
+    import os as _os
+
+    monkeypatch.syspath_prepend(
+        _os.path.join(_os.path.dirname(__file__), "..", "scripts"))
+    import bench_ladder
+
+    from lfm_quant_tpu.config import get_preset
+
+    monkeypatch.setenv("LFM_BENCH_DATES", "1")
+    cfg = bench_ladder._overrides(get_preset("c3"))
+    assert cfg.data.dates_per_batch == 1 and cfg.n_data_shards == 1
+    monkeypatch.delenv("LFM_BENCH_DATES")
+    cfg = bench_ladder._overrides(get_preset("c3"))
+    assert cfg.data.dates_per_batch == 8 and cfg.n_data_shards == 8
