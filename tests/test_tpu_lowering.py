@@ -166,3 +166,52 @@ def test_gather_c1_geometry_f32_lowers():
                                                interpret=False),
         _sds((N, T, Fp), jnp.float32),
         _sds((8, 128), jnp.int32), _sds((8,), jnp.int32))
+
+
+def test_c3_full_universe_geometry_lowers():
+    """The c3 full-universe bench geometry: GRU fused kernel in bf16 at
+    the per-shard batch (D=1 date × Bf=8192 full cross-section = 8192
+    rows, T=60, H=128) plus the Pallas DMA gather at the same width —
+    the exact shapes `scripts/chip_campaign.sh ladder-c3` dispatches.
+    Lowered here so scarce chip time never dies on a Mosaic verifier
+    error."""
+    B, T, H = 8192, 60, 128
+    G = 3 * H  # GRU
+
+    def loss(hin, wx, b, wh, m):
+        return (rnn_scan_fused("gru", hin, wx, b, wh, m,
+                               interpret=False).astype(jnp.float32)
+                ** 2).sum()
+
+    _lower_tpu(jax.grad(loss, argnums=(1, 2, 3)),
+               _sds((B, T, H), jnp.bfloat16), _sds((H, G), jnp.bfloat16),
+               _sds((G,), jnp.bfloat16), _sds((H, G), jnp.bfloat16),
+               _sds((B, T), jnp.bfloat16))
+
+    # bench_ladder trims the c3 panel to 240 months (already 8-aligned),
+    # so THIS is the panel extent ladder-c3 actually dispatches.
+    N, Tp, Fp, W = 8000, 240, 128, 60
+    _lower_tpu(
+        lambda xm, a, b: gather_windows_pallas(xm, a, b, window=W, fp=21,
+                                               interpret=False),
+        _sds((N, Tp, Fp), jnp.bfloat16),
+        _sds((1, 8192), jnp.int32), _sds((1,), jnp.int32))
+
+
+def test_c5_64_seed_geometry_lowers():
+    """The 64-seed HBM probe's kernel stack (chip_campaign.sh
+    seeds64-full): jit(vmap(grad)) over S=64 at the c5 per-seed batch
+    (B=2048, T=60, H=128, LSTM, bf16) — the widest seed grid any bench
+    dispatches."""
+    S, B, T, H = 64, 2048, 60, 128
+    G = 4 * H
+
+    def loss(hin, wx, b, wh, m):
+        return (rnn_scan_fused("lstm", hin, wx, b, wh, m,
+                               interpret=False).astype(jnp.float32)
+                ** 2).sum()
+
+    _lower_tpu(jax.vmap(jax.grad(loss, argnums=(1, 2, 3))),
+               _sds((S, B, T, H), jnp.bfloat16),
+               _sds((S, H, G), jnp.bfloat16), _sds((S, G), jnp.bfloat16),
+               _sds((S, H, G), jnp.bfloat16), _sds((S, B, T), jnp.bfloat16))
