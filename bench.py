@@ -232,6 +232,71 @@ def bench_c5_ensemble() -> None:
           per_seed_fm_s=round(value / n_seeds, 1))
 
 
+def _tunnel_probe() -> bool:
+    """Fail FAST (and diagnosably) when the tunneled device is wedged.
+
+    A wedged axon tunnel hangs every client at claim/init indefinitely
+    (BASELINE.md 2026-07-30 note) — round 2's driver capture died that
+    way with nothing in the log. Probe with a tiny matmul in a SUBPROCESS
+    (the hang is in backend init; it cannot be interrupted in-process),
+    retrying until LFM_BENCH_WAIT_S (default 600 s) elapses so a tunnel
+    that flaps back mid-window still yields a capture. Healthy tunnel
+    cost: one ~20 s subprocess (compile included); set
+    LFM_BENCH_SKIP_PROBE=1 when an outer harness (chip_campaign.sh) just
+    probed. A timed-out probe gets SIGTERM + a 10 s grace before SIGKILL
+    — a hard-killed client mid-claim is itself the documented wedge
+    trigger. The first attempt gets 180 s (cold compile + tunnel RTT);
+    an instant non-zero exit (< 5 s: ImportError, broken env — not a
+    tunnel condition) fails immediately instead of burning the window."""
+    import subprocess
+
+    if os.environ.get("LFM_BENCH_SKIP_PROBE") == "1":
+        return True
+    deadline = time.monotonic() + float(os.environ.get("LFM_BENCH_WAIT_S",
+                                                       "600"))
+    code = ("import jax, jax.numpy as jnp;"
+            "print('OK', float(jax.jit(lambda a: (a@a).sum())"
+            "(jnp.ones((256,256), jnp.bfloat16))))")
+    attempt = 0
+    while True:
+        attempt += 1
+        tmo = 180 if attempt == 1 else 90
+        t_start = time.monotonic()
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        try:
+            stdout, stderr = proc.communicate(timeout=tmo)
+            took = time.monotonic() - t_start
+            if proc.returncode == 0 and "OK" in stdout:
+                print(f"[bench] tunnel probe OK (attempt {attempt}, "
+                      f"{took:.0f}s)", file=sys.stderr, flush=True)
+                return True
+            detail = (stderr or stdout).strip()[-300:]
+            if took < 5:
+                print(f"[bench] probe failed instantly (not a tunnel "
+                      f"condition): {detail}", file=sys.stderr, flush=True)
+                return False
+        except subprocess.TimeoutExpired:
+            proc.terminate()  # SIGTERM first: let the client leave its claim
+            try:
+                proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+            detail = f"probe timed out at {tmo} s (wedged claim/init)"
+        remaining = deadline - time.monotonic()
+        print(f"[bench] tunnel probe attempt {attempt} failed: {detail}; "
+              f"{max(0, int(remaining))}s left in wait window",
+              file=sys.stderr, flush=True)
+        if remaining <= 60:
+            print("[bench] giving up: tunnel unhealthy for the whole wait "
+                  "window (set LFM_BENCH_WAIT_S to wait longer)",
+                  file=sys.stderr, flush=True)
+            return False
+        time.sleep(60)
+
+
 def main() -> int:
     # Hang forensics: the tunneled device has wedged before (a remote
     # compile that never returns leaves the client in a silent sleep
@@ -241,6 +306,8 @@ def main() -> int:
 
     faulthandler.dump_traceback_later(600, repeat=True)
     try:
+        if not _tunnel_probe():
+            return 1
         bench_c2()
         try:
             bench_c5_ensemble()

@@ -39,7 +39,8 @@ print('TUNNEL_OK', float(jax.jit(lambda a: a@a)(jnp.ones((256,256), jnp.bfloat16
 probe start
 
 # Driver metrics first: c2 + c5@16 re-verified with the fused kernel.
-TMO=600 step bench python bench.py
+# (probe-start just ran — skip bench.py's own self-probe.)
+TMO=600 step bench env LFM_BENCH_SKIP_PROBE=1 python bench.py
 
 # Unmeasured ladder rows (train + eval records each). c3 now trains
 # full-universe rank-IC (Bf ≈ 8192) — watch HBM; c2's eval row rides on
