@@ -55,9 +55,15 @@ TMO=600 step ladder-c4 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c4
 TMO=600 step ladder-lru python scripts/bench_ladder.py lru
 TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
 
-# The 64-seed axis at 64 on one chip (BASELINE.json:11): first the full
-# vmapped stack; if HBM refuses, the seed-microbatched fallback at
-# block 16. Risky by design — does not abort the campaign.
+# The 64-seed axis at 64 on one chip (BASELINE.json:11). First a
+# compile-only HBM probe (fails with RESOURCE_EXHAUSTED instead of a
+# mid-measurement OOM, and prints XLA's temp/argument byte analysis),
+# then the full vmapped stack; if HBM refuses, the seed-microbatched
+# fallback at block 16. Risky by design — does not abort the campaign.
+TMO=600 step seeds64-hbmprobe python scripts/hbm_probe.py c5 --seeds 64
+probe after-hbmprobe
+TMO=600 step seeds64-hbmprobe-blocked python scripts/hbm_probe.py c5 --seeds 64 --seed-block 16
+probe after-hbmprobe-blocked
 TMO=900 step seeds64-full env LFM_BENCH_SEEDS=64 python scripts/bench_ladder.py c5
 probe after-seeds64
 TMO=900 step seeds64-blocked env LFM_BENCH_SEEDS=64 LFM_BENCH_SEED_BLOCK=16 \

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Compile-only HBM footprint probe: does a ladder config's train step
+FIT the chip? (BASELINE.json:11 — the 64-seed HBM-fit question.)
+
+Compiles the real jitted step (no execution beyond state init) and
+prints XLA's memory analysis — argument/output/temp/generated-code
+bytes — as one JSON line. Much cheaper than a bench run and fails with
+a RESOURCE_EXHAUSTED compile error instead of a mid-measurement OOM, so
+the campaign learns the fit boundary without losing a timebox.
+
+Run: python scripts/hbm_probe.py c5 [--seeds 64] [--seed-block 16]
+     python scripts/hbm_probe.py c3 [--dates 1]
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("preset")
+    ap.add_argument("--seeds", type=int, default=0,
+                    help="override n_seeds (ensemble presets)")
+    ap.add_argument("--seed-block", type=int, default=0)
+    ap.add_argument("--dates", type=int, default=0,
+                    help="override dates_per_batch (per-shard batch)")
+    args = ap.parse_args(argv)
+
+    from bench_ladder import _bench_panel, _overrides
+    from lfm_quant_tpu.config import get_preset
+    from lfm_quant_tpu.train import Trainer
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+    # Same override stack as the bench step this probe predicts
+    # (scan_impl guarded to RNN kinds, gather reroute, LFM_BENCH_DATES) —
+    # a fit verdict for a different program would be worthless. CLI flags
+    # layer on top for manual use; the campaign drives everything via the
+    # same env vars as the bench steps.
+    cfg = _overrides(get_preset(args.preset))
+    seeds = args.seeds or int(os.environ.get("LFM_BENCH_SEEDS", "0"))
+    if seeds and cfg.n_seeds > 1:
+        cfg = dataclasses.replace(cfg, n_seeds=seeds)
+    seed_block = (args.seed_block
+                  or int(os.environ.get("LFM_BENCH_SEED_BLOCK", "0")))
+    if seed_block:
+        cfg = dataclasses.replace(cfg, seed_block=seed_block)
+    if args.dates:
+        cfg = dataclasses.replace(
+            cfg, data=dataclasses.replace(cfg.data,
+                                          dates_per_batch=args.dates),
+            n_data_shards=1)
+
+    splits = _bench_panel(cfg)
+    if cfg.n_seeds > 1:
+        trainer = EnsembleTrainer(cfg, splits)
+        state = trainer.init_state()
+        arrays = trainer._stacked_batch(
+            [s.epoch(0) for s in trainer.samplers])
+    else:
+        trainer = Trainer(cfg, splits)
+        state = trainer.init_state()
+        b = next(iter(trainer.train_sampler.epoch(0)))
+        arrays = trainer._batch_args(b, train=True)
+
+    rec = {"metric": f"hbm_probe_{args.preset}",
+           "n_seeds": cfg.n_seeds, "seed_block": cfg.seed_block,
+           "dates_per_batch": cfg.data.dates_per_batch}
+    lowered = trainer._jit_step.lower(state, trainer.dev, *arrays)
+    try:
+        compiled = lowered.compile()
+    except Exception as e:  # RESOURCE_EXHAUSTED = the probe's answer, not a crash
+        msg = str(e)
+        rec.update(fits=False, error=msg[:300])
+        print(json.dumps(rec), flush=True)
+        # Only an OOM-style compile failure is a clean "doesn't fit";
+        # anything else should still fail the step loudly.
+        return 0 if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg \
+            else 1
+    rec["fits"] = True
+    ma = None
+    try:
+        ma = compiled.memory_analysis()
+    except Exception as e:  # noqa: BLE001 — backend-dependent API
+        rec["memory_analysis"] = f"unavailable: {type(e).__name__}"
+    if ma is not None:
+        for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                     "temp_size_in_bytes", "alias_size_in_bytes",
+                     "generated_code_size_in_bytes"):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                rec[attr.replace("_in_bytes", "_mb")] = round(v / 1e6, 1)
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
