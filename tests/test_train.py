@@ -259,3 +259,38 @@ def test_bench_ladder_dates_override(monkeypatch):
     monkeypatch.delenv("LFM_BENCH_DATES")
     cfg = bench_ladder._overrides(get_preset("c3"))
     assert cfg.data.dates_per_batch == 8 and cfg.n_data_shards == 8
+
+
+def test_measure_eval_counts_real_firm_months(panel, tmp_path, monkeypatch):
+    """bench.measure_eval's firm-month accounting, pinned exactly: with a
+    frozen 2-second clock, rate == (real val weights × window [× seeds]) / 2
+    for BOTH trainer kinds — the harness behind the eval_throughput rows."""
+    import itertools
+
+    import bench as bench_mod
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+    cfg = tiny_cfg(out_dir=str(tmp_path))
+    dates = panel.dates
+    splits = PanelSplits.by_date(panel, int(dates[100]), int(dates[120]))
+
+    def frozen_clock():
+        # Each measured interval reads the clock twice: t0 then t0+2.
+        ticks = itertools.count()
+        return lambda: float(next(ticks) % 2) * 2.0
+
+    tr = Trainer(cfg, splits)
+    fm = float(tr.val_sampler.stacked_cross_sections().weight.sum()
+               ) * tr.window
+    monkeypatch.setattr(bench_mod.time, "perf_counter", frozen_clock())
+    v = bench_mod.measure_eval(tr, reps=1)
+    assert v == pytest.approx(fm / 2.0)
+
+    ecfg = tiny_cfg(n_seeds=2, out_dir=str(tmp_path))
+    etr = EnsembleTrainer(ecfg, splits)
+    efm = float(etr.val_sampler.stacked_cross_sections().weight.sum()
+                ) * etr.window * etr.n_seeds
+    monkeypatch.setattr(bench_mod.time, "perf_counter", frozen_clock())
+    ev = bench_mod.measure_eval(etr, reps=1)
+    assert ev == pytest.approx(efm / 2.0)
+    assert efm == pytest.approx(2.0 * fm)  # the seed stack doubles the count
