@@ -188,7 +188,22 @@ def _ladder() -> Dict[str, RunConfig]:
                           bf16=True),
         optim=OptimConfig(lr=1e-3, epochs=30, loss="mse"),
     )
-    return {c.name: c for c in (c1, c2, c3, c4, c5, lru)}
+    # Beyond-ladder: the LRU at the c5 ENSEMBLE geometry — if the
+    # time-parallel recurrence wins the single-model comparison, this is
+    # the row that decides the flagship ensemble recurrence
+    # (bench via LFM_BENCH_SEEDS like c5).
+    # Derived from `lru` so hyperparameter tuning there carries over —
+    # the decision row must measure the same model that won single-seed.
+    lru64 = dataclasses.replace(
+        lru,
+        name="lru64_c5_ensemble",
+        data=dataclasses.replace(c5.data),
+        model=dataclasses.replace(lru.model,
+                                  kwargs=dict(lru.model.kwargs)),
+        n_seeds=64,
+        n_data_shards=1,
+    )
+    return {c.name: c for c in (c1, c2, c3, c4, c5, lru, lru64)}
 
 
 PRESETS: Dict[str, RunConfig] = _ladder()

@@ -54,6 +54,9 @@ probe after-c3
 TMO=600 step ladder-c4 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c4
 TMO=600 step ladder-lru python scripts/bench_ladder.py lru
 TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
+# LRU at the c5 ensemble geometry (16 seeds, same as c5's default) —
+# the flagship-recurrence decision row.
+TMO=900 step ladder-lru64 python scripts/bench_ladder.py lru64
 
 # The 64-seed axis at 64 on one chip (BASELINE.json:11). First a
 # compile-only HBM probe (fails with RESOURCE_EXHAUSTED instead of a
