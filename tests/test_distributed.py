@@ -97,3 +97,116 @@ def test_two_process_smoke(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"proc {rank} OK" in out
+
+
+_MH_SETUP = textwrap.dedent("""
+    # Shared single-source setup for the two-process Trainer equivalence
+    # test: BOTH the worker subprocesses and the in-test reference import
+    # this module, so the two runs cannot drift apart.
+    from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.train import Trainer
+
+
+    def build_trainer():
+        cfg = RunConfig(
+            name="mh",
+            data=DataConfig(n_firms=120, n_months=140, n_features=4,
+                            window=8, dates_per_batch=4, firms_per_date=16),
+            model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+            optim=OptimConfig(lr=1e-2, epochs=1, warmup_steps=1,
+                              loss="mse"),
+            n_data_shards=4,
+        )
+        panel = synthetic_panel(n_firms=120, n_months=140, n_features=4,
+                                seed=7, min_history=60)
+        splits = PanelSplits.by_date(panel, 197706, 197901)
+        return Trainer(cfg, splits)
+
+
+    def run_three_steps(tr):
+        state = tr.init_state()
+        losses = []
+        it = tr.train_sampler.epoch(0)
+        for _ in range(3):
+            b = next(it)
+            fi, ti, w = tr._batch_args(b, train=True)
+            state, ms = tr._jit_step(state, tr.dev, fi, ti, w)
+            losses.append(float(ms["loss"]))
+        return losses
+""")
+
+_TRAIN_WORKER = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)  # 2 local -> 4 global
+    from lfm_quant_tpu.utils.distributed import maybe_initialize
+    assert maybe_initialize() is True
+    assert jax.process_count() == 2 and jax.device_count() == 4
+    # Every process builds the SAME panel and the SAME (seed-keyed)
+    # sampler batches - host-replicated inputs, globally sharded arrays.
+    import mh_setup
+    tr = mh_setup.build_trainer()
+    assert tr.mesh is not None and dict(tr.mesh.shape)["data"] == 4
+    losses = mh_setup.run_three_steps(tr)
+    print("LOSSES", " ".join(f"{x:.8f}" for x in losses), flush=True)
+""")
+
+
+def test_two_process_trainer_matches_single_process(tmp_path, monkeypatch):
+    """The REAL multi-host surface: a Trainer with a 4-way date-sharded
+    mesh spanning two processes must produce (nearly) the same losses as
+    the identical single-process run - host-replicated index batches in,
+    globally-sharded step with psum'd gradients out."""
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+    except OSError:
+        pytest.skip("no localhost socket access")
+
+    (tmp_path / "mh_setup.py").write_text(_MH_SETUP)
+    script = tmp_path / "train_worker.py"
+    script.write_text(_TRAIN_WORKER)
+    env_base = {
+        "LFM_COORDINATOR": f"127.0.0.1:{port}",
+        "LFM_NUM_PROCESSES": "2",
+        "JAX_PLATFORMS": "cpu",
+        "PATH": "/usr/bin:/bin",
+        "PYTHONPATH": ":".join(sys.path + [str(tmp_path)]),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script)],
+            env={**env_base, "LFM_PROCESS_ID": str(rank)},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"two-process trainer timed out; partial: {outs}")
+    loss_lines = []
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        line = [l for l in out.splitlines() if l.startswith("LOSSES")]
+        assert line, out
+        loss_lines.append(line[0])
+    # Both processes computed the same global losses.
+    assert loss_lines[0] == loss_lines[1]
+
+    # Single-process reference on a 4-device mesh: same module, same setup.
+    import numpy as np
+
+    monkeypatch.syspath_prepend(str(tmp_path))
+    import mh_setup
+
+    ref = mh_setup.run_three_steps(mh_setup.build_trainer())
+    got = [float(x) for x in loss_lines[0].split()[1:]]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
