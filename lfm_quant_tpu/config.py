@@ -95,6 +95,14 @@ class RunConfig:
     seed: int = 0
     n_seeds: int = 1  # >1 → ensemble (vmapped replicas)
     n_data_shards: int = 1  # data-parallel axis size
+    # Sequence/context parallelism: >1 shards the WINDOW axis of the
+    # train-step forward over a ('seq',) device mesh — ring attention for
+    # the transformer, distributed associative scan for the LRU
+    # (parallel/ring.py, models/lru.py). The long-context training mode
+    # for windows that outgrow one chip. Transformer/lru only (a serial
+    # LSTM/GRU recurrence cannot window-shard); currently exclusive with
+    # n_data_shards/n_seeds meshes; window must divide by it.
+    n_seq_shards: int = 1
     # Seed microbatching: >0 scans the (per-device) seed stack in blocks
     # of this size inside the train step, bounding activation memory to
     # seed_block × per-seed instead of all resident seeds at once — the
@@ -126,6 +134,7 @@ class RunConfig:
             seed=raw.get("seed", 0),
             n_seeds=raw.get("n_seeds", 1),
             n_data_shards=raw.get("n_data_shards", 1),
+            n_seq_shards=raw.get("n_seq_shards", 1),
             seed_block=raw.get("seed_block", 0),
             out_dir=raw.get("out_dir", "runs"),
         )
@@ -232,7 +241,8 @@ def get_preset(name: str) -> RunConfig:
 
 
 def model_kwargs(cfg: RunConfig, mesh=None,
-                 force_xla_scan: bool = False) -> Tuple[str, Dict[str, Any]]:
+                 force_xla_scan: bool = False,
+                 seq_axis: bool = False) -> Tuple[str, Dict[str, Any]]:
     """Resolve ModelConfig into build_model(kind, **kwargs) arguments.
 
     "auto" scan_impl picks the fused Pallas recurrence on a real TPU. A
@@ -241,6 +251,10 @@ def model_kwargs(cfg: RunConfig, mesh=None,
     un-partitioned and a pallas_call is legal. ``force_xla_scan=True``
     overrides to the GSPMD-partitionable ``lax.scan`` — trainers use it to
     build the eval-forward model, which stays outside shard_map.
+    ``seq_axis=True`` builds the window-sharded (sequence-parallel)
+    variant — transformer/lru only; the trainer passes it for its train
+    model when ``cfg.n_seq_shards > 1`` (checkpoints interchange with the
+    plain variant — no per-position params).
     """
     import jax
     import jax.numpy as jnp
@@ -260,4 +274,11 @@ def model_kwargs(cfg: RunConfig, mesh=None,
             kw["scan_impl"] = impl
         if force_xla_scan:
             kw["scan_impl"] = "xla"
+    if seq_axis:
+        if cfg.model.kind not in ("transformer", "lru"):
+            raise ValueError(
+                f"n_seq_shards > 1 needs a window-shardable model "
+                f"(transformer | lru), got {cfg.model.kind!r} — a serial "
+                "recurrence cannot shard its time axis")
+        kw["seq_axis"] = "seq"
     return cfg.model.kind, kw

@@ -63,6 +63,10 @@ class EnsembleTrainer:
                  run_dir: Optional[str] = None, echo: bool = False):
         if cfg.n_seeds < 2:
             raise ValueError("EnsembleTrainer needs n_seeds >= 2")
+        if cfg.n_seq_shards > 1:
+            raise ValueError(
+                "n_seq_shards > 1 does not compose with the seed-vmapped "
+                "ensemble yet — train sequence-parallel models single-seed")
         self.cfg = cfg
         self.splits = splits
         self.run_dir = run_dir

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import warnings
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -258,15 +259,51 @@ class Trainer:
                 f"dates_per_batch={d.dates_per_batch} must be divisible by "
                 f"n_data_shards={n_data}")
 
+        # Sequence/context parallelism (long-context training): shard the
+        # WINDOW axis of the train-step forward over a ('seq',) mesh —
+        # ring attention (transformer) / distributed associative scan
+        # (lru). The eval forward keeps the plain full-window model
+        # (checkpoint-compatible: no per-position params).
+        self.seq_mesh = None
+        if cfg.n_seq_shards > 1:
+            if self.mesh is not None:
+                raise ValueError(
+                    "n_seq_shards > 1 does not compose with a data/seed "
+                    "mesh yet — set n_data_shards=1 and n_seeds=1")
+            if self._needs_rng:
+                raise ValueError(
+                    "dropout is unsupported under sequence parallelism "
+                    "(shard-local masks would decorrelate; see "
+                    "models/transformer.py)")
+            # Degrade gracefully to the visible device count (matching the
+            # data mesh above): a pod-trained config must stay loadable
+            # for eval/backtest on a smaller host, where only the
+            # full-window eval model runs anyway. n_seq == 1 → plain
+            # training (params are interchangeable by contract).
+            n_seq = min(cfg.n_seq_shards, jax.device_count())
+            if n_seq < cfg.n_seq_shards:
+                warnings.warn(
+                    f"n_seq_shards={cfg.n_seq_shards} exceeds the "
+                    f"{jax.device_count()} visible devices; degrading to "
+                    f"{n_seq}", stacklevel=2)
+            if n_seq > 1:
+                if d.window % n_seq:
+                    raise ValueError(
+                        f"window={d.window} must divide by "
+                        f"n_seq_shards={n_seq}")
+                from lfm_quant_tpu.parallel import seq_mesh as _seq_mesh
+
+                self.seq_mesh = _seq_mesh(n_seq)
+
         # Train model: the Pallas fused recurrence survives the mesh
         # because the train step runs inside shard_map (locally
         # un-partitioned per shard). The eval forward stays GSPMD-
         # partitioned, so under a mesh it gets a twin model on the XLA
         # scan — parameter trees are identical between scan impls
         # (models/rnn.py _GateKernel path aliasing), so params interchange.
-        kind, kwargs = model_kwargs(cfg)
+        kind, kwargs = model_kwargs(cfg, seq_axis=self.seq_mesh is not None)
         self.model = build_model(kind, **kwargs)
-        if self.mesh is not None:
+        if self.mesh is not None or self.seq_mesh is not None:
             ekind, ekwargs = model_kwargs(cfg, force_xla_scan=True)
             self.eval_model = build_model(ekind, **ekwargs)
         else:
@@ -351,12 +388,19 @@ class Trainer:
         """Flatten [D, Bf] batch dims → one big MXU batch, reapply shape.
 
         ``rng``: dropout key — training passes it when dropout is
-        configured (deterministic=False); eval never does."""
+        configured (deterministic=False); eval never does. Under sequence
+        parallelism the TRAIN model's forward runs window-sharded via
+        ``sequence_parallel_apply`` (the eval twin stays full-window)."""
         model = model or self.model
         lead = x.shape[:-2]
         xf = x.reshape((-1,) + x.shape[-2:])
         mf = m.reshape((-1,) + m.shape[-1:])
-        if rng is not None:
+        if self.seq_mesh is not None and model is self.model:
+            from lfm_quant_tpu.parallel import sequence_parallel_apply
+
+            out = sequence_parallel_apply(model, params, xf, mf,
+                                          self.seq_mesh)
+        elif rng is not None:
             out = model.apply({"params": params}, xf, mf,
                               deterministic=False, rngs={"dropout": rng})
         else:
@@ -502,12 +546,14 @@ class Trainer:
     # ---- public API --------------------------------------------------
 
     def _commit_state(self, state: TrainState) -> TrainState:
-        """Re-place a state on the data-parallel mesh (replicated). Needed
+        """Re-place a state on the trainer's mesh (replicated). Needed
         after an Orbax restore: restored arrays arrive committed to one
-        device, which conflicts with the mesh-replicated panel inside jit."""
-        if self.mesh is None:
+        device, which conflicts with the mesh-replicated panel inside jit
+        — true for the data mesh AND the sequence ('seq',) mesh."""
+        mesh = self.mesh if self.mesh is not None else self.seq_mesh
+        if mesh is None:
             return state
-        return jax.device_put(state, replicated(self.mesh))
+        return jax.device_put(state, replicated(mesh))
 
     def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
         if rng is None:
@@ -515,7 +561,12 @@ class Trainer:
         d = self.cfg.data
         x = jnp.zeros((2, d.window, self.splits.panel.n_features), jnp.float32)
         m = jnp.ones((2, d.window), bool)
-        params = self.model.init(rng, x, m)["params"]
+        # Under sequence parallelism init with the plain full-window twin:
+        # the seq model only traces inside shard_map (its psums need the
+        # live axis), and the param trees are identical by contract.
+        init_model = (self.eval_model if self.seq_mesh is not None
+                      else self.model)
+        params = init_model.init(rng, x, m)["params"]
         # Raw uint32 key data (checkpoint-friendly); distinct from the init
         # stream, and per-member under the ensemble's vmapped init.
         state_rng = jax.random.key_data(jax.random.fold_in(rng, 0x0D0))
