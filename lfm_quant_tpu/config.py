@@ -212,7 +212,20 @@ def _ladder() -> Dict[str, RunConfig]:
         n_seeds=64,
         n_data_shards=1,
     )
-    return {c.name: c for c in (c1, c2, c3, c4, c5, lru, lru64)}
+    # Beyond-ladder: the long-context mode at preset level — a 240-month
+    # (20-year) window transformer with the window axis sharded 8 ways
+    # (ring attention; n_seq_shards degrades to the visible devices).
+    lc = RunConfig(
+        name="lc_transformer_seq8",
+        data=DataConfig(n_firms=4000, n_months=600, n_features=20,
+                        window=240, dates_per_batch=8, firms_per_date=128),
+        model=ModelConfig(kind="transformer",
+                          kwargs={"dim": 64, "depth": 2, "heads": 4},
+                          bf16=True),
+        optim=OptimConfig(lr=5e-4, epochs=30, loss="mse"),
+        n_seq_shards=8,
+    )
+    return {c.name: c for c in (c1, c2, c3, c4, c5, lru, lru64, lc)}
 
 
 PRESETS: Dict[str, RunConfig] = _ladder()

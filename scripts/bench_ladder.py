@@ -198,7 +198,7 @@ def bench_config(name: str):
 
 
 def main(argv) -> int:
-    names = argv or ["c1", "c2", "c3", "c4", "c5", "lru", "lru64"]
+    names = argv or ["c1", "c2", "c3", "c4", "c5", "lru", "lru64", "lc"]
     for name in names:
         for rec in bench_config(name):
             print(json.dumps(rec), flush=True)

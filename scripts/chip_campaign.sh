@@ -22,7 +22,7 @@ step() {
   rc=$?
   echo "--- $name rc=$rc" | tee -a "$LOG"
   case "$name" in
-    c1diag*|seeds64*|sweep*|c3-fullD) ;;  # expected-risky: don't abort
+    c1diag*|seeds64*|sweep*|c3-fullD|ladder-lc) ;;  # expected-risky: don't abort
     *) if [ $rc -ne 0 ]; then
          echo "!!! $name failed — aborting (tunnel may be wedged)" | tee -a "$LOG"
          exit $rc
@@ -57,6 +57,11 @@ TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
 # LRU at the c5 ensemble geometry (16 seeds, same as c5's default) —
 # the flagship-recurrence decision row.
 TMO=900 step ladder-lru64 python scripts/bench_ladder.py lru64
+# Long-context row: 240-month-window transformer (n_seq_shards degrades
+# to the 1 visible chip — full-window attention at window 240). First
+# on-chip run of this geometry → risky (OOM must not abort the session).
+TMO=900 step ladder-lc python scripts/bench_ladder.py lc
+probe after-lc
 
 # The 64-seed axis at 64 on one chip (BASELINE.json:11). First a
 # compile-only HBM probe (fails with RESOURCE_EXHAUSTED instead of a
