@@ -4,6 +4,7 @@ sequence/context parallelism (ring attention over a 'seq' axis)."""
 from lfm_quant_tpu.parallel.mesh import (
     DATA_AXIS,
     SEED_AXIS,
+    SEQ_AXIS,
     batch_sharding,
     make_mesh,
     replicated,
@@ -21,6 +22,7 @@ from lfm_quant_tpu.parallel.ring import (
 __all__ = [
     "SEED_AXIS",
     "DATA_AXIS",
+    "SEQ_AXIS",
     "make_mesh",
     "replicated",
     "batch_sharding",

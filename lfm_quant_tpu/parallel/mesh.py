@@ -34,14 +34,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SEED_AXIS = "seed"
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"  # matches parallel/ring.py's axis name
 
 
 def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
-              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a (seed × data) mesh over the available devices.
+              devices: Optional[Sequence[jax.Device]] = None,
+              n_seq: int = 1) -> Mesh:
+    """Build a (seed × data[× seq]) mesh over the available devices.
 
     ``n_data`` defaults to ``len(devices) // n_seed``. A 1×1 mesh on a
-    single device is valid and keeps the code path uniform.
+    single device is valid and keeps the code path uniform. ``n_seq > 1``
+    appends a 'seq' axis (sequence/context parallelism — the window axis
+    of the train forward; see parallel/ring.py) as the INNERMOST mesh
+    dimension, so its per-layer collectives (ring ppermute / scan psum)
+    ride physically-adjacent ICI links.
 
     Topology awareness: when the mesh spans ALL devices, the grid comes
     from ``mesh_utils`` so the 'data' axis (the only axis with a per-step
@@ -55,14 +61,16 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
     explicit = devices is not None
     devices = list(devices if explicit else jax.devices())
     if n_data is None:
-        if len(devices) % n_seed:
+        if len(devices) % (n_seed * n_seq):
             raise ValueError(
-                f"{len(devices)} devices not divisible by n_seed={n_seed}")
-        n_data = len(devices) // n_seed
-    need = n_seed * n_data
+                f"{len(devices)} devices not divisible by "
+                f"n_seed×n_seq={n_seed * n_seq}")
+        n_data = len(devices) // (n_seed * n_seq)
+    shape = (n_seed, n_data, n_seq)
+    need = n_seed * n_data * n_seq
     if need > len(devices):
         raise ValueError(
-            f"mesh {n_seed}x{n_data} needs {need} devices, "
+            f"mesh {n_seed}x{n_data}x{n_seq} needs {need} devices, "
             f"have {len(devices)}")
     grid = None
     if not explicit and need == len(devices):
@@ -72,11 +80,11 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
             n_proc = jax.process_count()
             if n_proc > 1 and n_seed % n_proc == 0:
                 grid = mesh_utils.create_hybrid_device_mesh(
-                    (n_seed // n_proc, n_data),
-                    dcn_mesh_shape=(n_proc, 1),
-                ).reshape(n_seed, n_data)
+                    (n_seed // n_proc, n_data, n_seq),
+                    dcn_mesh_shape=(n_proc, 1, 1),
+                ).reshape(shape)
             else:
-                grid = mesh_utils.create_device_mesh((n_seed, n_data))
+                grid = mesh_utils.create_device_mesh(shape)
         except Exception as e:  # pragma: no cover - topology-dependent
             import warnings
 
@@ -87,8 +95,10 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
                 RuntimeWarning, stacklevel=2)
             grid = None
     if grid is None:
-        grid = np.asarray(devices[:need]).reshape(n_seed, n_data)
-    return Mesh(grid, (SEED_AXIS, DATA_AXIS))
+        grid = np.asarray(devices[:need]).reshape(shape)
+    if n_seq > 1:
+        return Mesh(grid, (SEED_AXIS, DATA_AXIS, SEQ_AXIS))
+    return Mesh(grid.reshape(n_seed, n_data), (SEED_AXIS, DATA_AXIS))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

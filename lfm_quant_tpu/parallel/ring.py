@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-SEQ_AXIS = "seq"
+from lfm_quant_tpu.parallel.mesh import SEQ_AXIS  # single source of truth
 
 _NEG = -1e30  # additive mask for invalid keys (f32-safe, exp() == 0.0)
 

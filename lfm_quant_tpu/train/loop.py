@@ -19,7 +19,8 @@ import dataclasses
 import json
 import os
 import warnings
-from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+from typing import (Any, Callable, Dict, NamedTuple, Optional, Tuple,
+                    Union)
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +38,8 @@ from lfm_quant_tpu.data.windows import (
     resolve_gather_impl,
 )
 from lfm_quant_tpu.models import build_model
-from lfm_quant_tpu.parallel import DATA_AXIS, make_mesh, replicated, shard_batch
+from lfm_quant_tpu.parallel import (DATA_AXIS, SEQ_AXIS, make_mesh,
+                                    replicated, shard_batch)
 from lfm_quant_tpu.ops import (
     finalize_loss,
     make_loss_parts,
@@ -247,53 +249,52 @@ class Trainer:
         self._needs_rng = float(cfg.model.kwargs.get("dropout") or 0.0) > 0.0
 
         # Data-parallel mesh (SURVEY.md §8 step 8): shard the DATE axis of
-        # each batch so monthly cross-sections stay shard-local for rank-IC.
-        # Degrades gracefully to fewer devices than configured shards.
+        # each batch so monthly cross-sections stay shard-local for
+        # rank-IC. With ``n_seq_shards > 1`` the mesh gains an innermost
+        # 'seq' axis — sequence/context parallelism for the train forward
+        # (ring attention for the transformer, distributed associative
+        # scan for the LRU); the two compose: batches shard dates over
+        # 'data' and replicate over 'seq', where each shard runs its
+        # window slice. Both axes degrade gracefully to fewer devices
+        # than configured (data first — it reduces step memory; a
+        # pod-trained config must stay loadable for eval/backtest on a
+        # smaller host, where only the full-window eval model runs).
+        self._n_seq = 1
         if mesh == "auto":
             n_data = max(1, min(cfg.n_data_shards, jax.device_count()))
-            mesh = make_mesh(1, n_data) if n_data > 1 else None
+            if cfg.n_seq_shards > 1:
+                if self._needs_rng:
+                    raise ValueError(
+                        "dropout is unsupported under sequence parallelism "
+                        "(shard-local masks would decorrelate; see "
+                        "models/transformer.py)")
+                self._n_seq = max(1, min(cfg.n_seq_shards,
+                                         jax.device_count() // n_data))
+                if self._n_seq < cfg.n_seq_shards:
+                    warnings.warn(
+                        f"n_seq_shards={cfg.n_seq_shards} exceeds the "
+                        f"devices left by the data axis "
+                        f"({jax.device_count()} // {n_data}); degrading "
+                        f"to {self._n_seq}", stacklevel=2)
+                if self._n_seq > 1 and d.window % self._n_seq:
+                    raise ValueError(
+                        f"window={d.window} must divide by "
+                        f"n_seq_shards={self._n_seq}")
+            mesh = (make_mesh(1, n_data, n_seq=self._n_seq)
+                    if n_data * self._n_seq > 1 else None)
+        elif cfg.n_seq_shards > 1:
+            raise ValueError(
+                "n_seq_shards > 1 requires the trainer's own mesh "
+                "(mesh='auto'); wrapper-provided meshes (ensembles) do "
+                "not carry a seq axis")
         self.mesh = mesh
+        # Test/introspection alias: the mesh carrying the live seq axis.
+        self.seq_mesh = mesh if self._n_seq > 1 else None
         n_data = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
         if d.dates_per_batch % n_data:
             raise ValueError(
                 f"dates_per_batch={d.dates_per_batch} must be divisible by "
                 f"n_data_shards={n_data}")
-
-        # Sequence/context parallelism (long-context training): shard the
-        # WINDOW axis of the train-step forward over a ('seq',) mesh —
-        # ring attention (transformer) / distributed associative scan
-        # (lru). The eval forward keeps the plain full-window model
-        # (checkpoint-compatible: no per-position params).
-        self.seq_mesh = None
-        if cfg.n_seq_shards > 1:
-            if self.mesh is not None:
-                raise ValueError(
-                    "n_seq_shards > 1 does not compose with a data/seed "
-                    "mesh yet — set n_data_shards=1 and n_seeds=1")
-            if self._needs_rng:
-                raise ValueError(
-                    "dropout is unsupported under sequence parallelism "
-                    "(shard-local masks would decorrelate; see "
-                    "models/transformer.py)")
-            # Degrade gracefully to the visible device count (matching the
-            # data mesh above): a pod-trained config must stay loadable
-            # for eval/backtest on a smaller host, where only the
-            # full-window eval model runs anyway. n_seq == 1 → plain
-            # training (params are interchangeable by contract).
-            n_seq = min(cfg.n_seq_shards, jax.device_count())
-            if n_seq < cfg.n_seq_shards:
-                warnings.warn(
-                    f"n_seq_shards={cfg.n_seq_shards} exceeds the "
-                    f"{jax.device_count()} visible devices; degrading to "
-                    f"{n_seq}", stacklevel=2)
-            if n_seq > 1:
-                if d.window % n_seq:
-                    raise ValueError(
-                        f"window={d.window} must divide by "
-                        f"n_seq_shards={n_seq}")
-                from lfm_quant_tpu.parallel import seq_mesh as _seq_mesh
-
-                self.seq_mesh = _seq_mesh(n_seq)
 
         # Train model: the Pallas fused recurrence survives the mesh
         # because the train step runs inside shard_map (locally
@@ -301,9 +302,11 @@ class Trainer:
         # partitioned, so under a mesh it gets a twin model on the XLA
         # scan — parameter trees are identical between scan impls
         # (models/rnn.py _GateKernel path aliasing), so params interchange.
-        kind, kwargs = model_kwargs(cfg, seq_axis=self.seq_mesh is not None)
+        # Under sequence parallelism the train model is the seq_axis-aware
+        # variant (checkpoint-compatible: no per-position params).
+        kind, kwargs = model_kwargs(cfg, seq_axis=self._n_seq > 1)
         self.model = build_model(kind, **kwargs)
-        if self.mesh is not None or self.seq_mesh is not None:
+        if self.mesh is not None:
             ekind, ekwargs = model_kwargs(cfg, force_xla_scan=True)
             self.eval_model = build_model(ekind, **ekwargs)
         else:
@@ -325,6 +328,12 @@ class Trainer:
         # reads the lane-padded panel via the logical fp width).
         self._gather_impl = resolve_gather_impl(
             d.gather_impl, self.mesh, splits.panel, d.window)
+        if self._n_seq > 1:
+            # Sequence-parallel steps gather only the shard's SUB-window
+            # (window // n_seq months) — the Pallas DMA gather's aligned
+            # spans are validated for the full window only, so the train
+            # gather takes the XLA path under a seq axis.
+            self._gather_impl = "xla"
         self._eval_gather_impl = (
             self._gather_impl if self.mesh is None else "xla")
         self._fp = splits.panel.n_features + 1  # logical packed width
@@ -366,16 +375,22 @@ class Trainer:
         """Wrap a step impl in shard_map over this trainer's mesh.
 
         State and panel replicate (P()); index batches shard their date
-        axis. out_specs are P() because the psum'd gradients make every
-        shard's update identical (check_vma=False: the replication is
-        mathematical, not provable by the varying-axes checker)."""
+        axis (and replicate over the seq axis when present — every seq
+        shard sees the full batch and runs its window slice). out_specs
+        are P() because the psum'd gradients make every shard's update
+        identical (check_vma=False: the replication is mathematical, not
+        provable by the varying-axes checker). With a live seq axis the
+        step psums over BOTH batch axes: loss num/den each pick up the
+        same ×n_seq duplication (the ratio is exact), and the per-shard
+        window-slice gradients sum to the full-window gradient."""
         import functools
 
         from jax.sharding import PartitionSpec as P
 
+        axes = ((DATA_AXIS, SEQ_AXIS) if self._n_seq > 1 else (DATA_AXIS,))
         batch = P(None, DATA_AXIS) if steps_axis else P(DATA_AXIS)
         return jax.shard_map(
-            functools.partial(impl, axis=DATA_AXIS),
+            functools.partial(impl, axis=axes),
             mesh=self.mesh,
             in_specs=(P(), P(), batch, batch, batch),
             out_specs=(P(), P()),
@@ -389,18 +404,15 @@ class Trainer:
 
         ``rng``: dropout key — training passes it when dropout is
         configured (deterministic=False); eval never does. Under sequence
-        parallelism the TRAIN model's forward runs window-sharded via
-        ``sequence_parallel_apply`` (the eval twin stays full-window)."""
+        parallelism the STEP hands this the shard's pre-gathered
+        sub-window (see ``_step_impl``); the seq-aware model's live-axis
+        collectives (ring attention / distributed scan + psum pooling)
+        make every shard return the identical full pooled output."""
         model = model or self.model
         lead = x.shape[:-2]
         xf = x.reshape((-1,) + x.shape[-2:])
         mf = m.reshape((-1,) + m.shape[-1:])
-        if self.seq_mesh is not None and model is self.model:
-            from lfm_quant_tpu.parallel import sequence_parallel_apply
-
-            out = sequence_parallel_apply(model, params, xf, mf,
-                                          self.seq_mesh)
-        elif rng is not None:
+        if rng is not None:
             out = model.apply({"params": params}, xf, mf,
                               deterministic=False, rngs={"dropout": rng})
         else:
@@ -409,16 +421,19 @@ class Trainer:
             return tuple(o.reshape(lead) for o in out)
         return out.reshape(lead)
 
-    def _gather(self, xm, firm_idx, time_idx, impl=None):
+    def _gather(self, xm, firm_idx, time_idx, impl=None, window=None):
         """The resolved window gather (ops/pallas_gather.py DMA kernel or
         the XLA row gather). Both read the panel through the logical
-        packed width ``fp`` — the panel may be lane-padded (Pallas)."""
+        packed width ``fp`` — the panel may be lane-padded (Pallas).
+        ``window`` overrides the lookback length (the sequence-parallel
+        step gathers per-shard sub-windows)."""
         impl = impl or self._gather_impl
+        window = window or self.window
         if impl == "pallas":
             from lfm_quant_tpu.ops.pallas_gather import gather_windows_pallas
 
             return gather_windows_pallas(
-                xm, firm_idx, time_idx, self.window, fp=self._fp)
+                xm, firm_idx, time_idx, window, fp=self._fp)
         # Full-universe widths chunk the firm axis so the [D, Bf, T, F]
         # row transient stays bounded (the Pallas DMA gather above never
         # materializes rows, so it needs no chunking).
@@ -426,11 +441,12 @@ class Trainer:
 
         chunk = FIRM_CHUNK if firm_idx.shape[-1] >= 2 * FIRM_CHUNK else None
         return gather_windows_packed(
-            xm, firm_idx, time_idx, self.window, fp=self._fp,
+            xm, firm_idx, time_idx, window, fp=self._fp,
             firm_chunk=chunk)
 
     def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
-                   weight, axis: Optional[str] = None):
+                   weight,
+                   axis: Optional[Union[str, Tuple[str, ...]]] = None):
         """One train step. ``axis`` names the mesh axis this step runs
         under inside shard_map (None = un-partitioned): the loss is a
         ratio of data-sums, so the global value needs one psum per part,
@@ -439,13 +455,30 @@ class Trainer:
         if self._needs_rng:
             # Derived, never stored: resume replays the same stream; the
             # shard index decorrelates dropout masks across data shards.
+            # (axis may be a tuple of names; dropout is rejected under a
+            # live seq axis, so folding each name stays per-data-shard.)
             step_rng = jax.random.fold_in(state.rng, state.step)
             if axis is not None:
-                step_rng = jax.random.fold_in(
-                    step_rng, jax.lax.axis_index(axis))
+                names = (axis,) if isinstance(axis, str) else axis
+                for nm in names:
+                    step_rng = jax.random.fold_in(
+                        step_rng, jax.lax.axis_index(nm))
 
         def loss_of(params):
-            x, m = self._gather(dev["xm"], firm_idx, time_idx)
+            if self._n_seq > 1:
+                # Gather only this seq shard's SUB-window: absolute window
+                # positions [s·wl, (s+1)·wl) end at anchor − (W − (s+1)·wl),
+                # so each shard moves 1/n_seq of the gather bytes and
+                # holds 1/n_seq of the input transient. Young anchors
+                # degrade exactly like the full gather (pre-history
+                # positions mask False — pinned by test).
+                wl = self.window // self._n_seq
+                shift = (self.window
+                         - (jax.lax.axis_index(SEQ_AXIS) + 1) * wl)
+                x, m = self._gather(dev["xm"], firm_idx, time_idx - shift,
+                                    window=wl)
+            else:
+                x, m = self._gather(dev["xm"], firm_idx, time_idx)
             y = gather_targets(dev["targets"], firm_idx, time_idx)
             out = self._apply(params, x, m, rng=step_rng)
             num, den = self.loss_parts(out, y, weight)
@@ -465,7 +498,7 @@ class Trainer:
         }
 
     def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w,
-                         axis: Optional[str] = None):
+                         axis: Optional[Union[str, Tuple[str, ...]]] = None):
         """K training steps in ONE compiled dispatch: lax.scan over a
         [K, D, Bf] index stack. Per-step dispatch latency (25–30 ms on a
         tunneled device) would otherwise dwarf the ~ms of real compute per

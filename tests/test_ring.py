@@ -304,8 +304,6 @@ def test_seq_parallel_config_validation(tmp_path):
                                     n_features=5, window=10,
                                     dates_per_batch=4,
                                     firms_per_date=24)), splits)
-    with _pytest.raises(ValueError, match="compose"):
-        Trainer(cfg(n_data_shards=2), splits)
     with _pytest.raises(ValueError, match="dropout"):
         Trainer(cfg(model=ModelConfig(
             kind="transformer",
@@ -359,3 +357,41 @@ def test_seq_parallel_resume_and_degrade(tmp_path):
     assert dict(tr3.seq_mesh.shape)["seq"] == 8
     assert np.isfinite(s3["best_val_ic"])
 
+
+
+def test_seq_parallel_composes_with_data_parallel(tmp_path):
+    """SP × DP on one mesh: n_data_shards=2 × n_seq_shards=4 over the 8
+    virtual devices — batches shard dates over 'data', each seq shard
+    runs its window slice — must reproduce the plain run's losses (the
+    grads psum over both axes; the num/den seq duplication cancels)."""
+    import numpy as np
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.train.loop import run_experiment
+
+    panel = synthetic_panel(n_firms=150, n_months=150, n_features=5,
+                            seed=17)
+
+    def cfg(n_data, n_seq, name):
+        return RunConfig(
+            name=name,
+            data=DataConfig(n_firms=150, n_months=150, n_features=5,
+                            window=8, dates_per_batch=4,
+                            firms_per_date=32),
+            model=ModelConfig(kind="transformer",
+                              kwargs={"dim": 16, "depth": 1, "heads": 2}),
+            optim=OptimConfig(lr=3e-3, epochs=2, warmup_steps=5,
+                              loss="mse"),
+            n_data_shards=n_data, n_seq_shards=n_seq,
+            out_dir=str(tmp_path),
+        )
+
+    s_plain, _, _ = run_experiment(cfg(1, 1, "comp_plain"), panel=panel)
+    s_comp, tr, _ = run_experiment(cfg(2, 4, "comp_dp_sp"), panel=panel)
+    assert dict(tr.mesh.shape) == {"seed": 1, "data": 2, "seq": 4}
+    a = [h["train_loss"] for h in s_plain["history"]]
+    b = [h["train_loss"] for h in s_comp["history"]]
+    np.testing.assert_allclose(b, a, rtol=2e-3)
+    assert abs(s_comp["best_val_ic"] - s_plain["best_val_ic"]) < 0.05

@@ -269,3 +269,32 @@ def test_gather_firm_chunked_matches_unchunked(panel):
         firm_chunk=16)
     np.testing.assert_array_equal(np.asarray(m0[:, :50]), np.asarray(mn))
     np.testing.assert_array_equal(np.asarray(x0[:, :50]), np.asarray(xn))
+
+
+def test_sub_window_gather_equals_slice_of_full(panel):
+    """The sequence-parallel step gathers per-shard SUB-windows (length
+    W/n ending at anchor − (W − (s+1)·wl)); each must equal the matching
+    slice of the full-window gather — including young anchors whose early
+    shards fall entirely before the firm's history."""
+    from lfm_quant_tpu.data import gather_windows_packed
+
+    dev = device_panel(panel)
+    n = 4
+    wl = WINDOW // n
+    rng = np.random.default_rng(12)
+    fi = rng.integers(0, panel.n_firms, size=(3, 8)).astype(np.int32)
+    # anchors: normal + young (t < W-1, so shard 0's sub-window is fully
+    # pre-history) + very young
+    ti = np.asarray([panel.n_months - 2, WINDOW // 2, 3], np.int32)
+    xf, mf = jax.jit(gather_windows_packed, static_argnames="window")(
+        dev["xm"], jnp.asarray(fi), jnp.asarray(ti), window=WINDOW)
+    for s in range(n):
+        shift = WINDOW - (s + 1) * wl
+        xs, ms = jax.jit(gather_windows_packed, static_argnames="window")(
+            dev["xm"], jnp.asarray(fi), jnp.asarray(ti - shift), window=wl)
+        np.testing.assert_array_equal(
+            np.asarray(mf)[:, :, s * wl:(s + 1) * wl], np.asarray(ms),
+            err_msg=f"shard {s} mask")
+        np.testing.assert_array_equal(
+            np.asarray(xf)[:, :, s * wl:(s + 1) * wl], np.asarray(xs),
+            err_msg=f"shard {s} features")
