@@ -101,6 +101,23 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
     return Mesh(grid.reshape(n_seed, n_data), (SEED_AXIS, DATA_AXIS))
 
 
+def resolve_seq_shards(requested: int, devices_left: int) -> int:
+    """Degrade a requested seq-axis size to the devices actually left
+    over (after the seed/data axes took theirs), warning when it shrinks
+    — the shared contract that keeps pod-trained configs loadable for
+    eval/backtest on smaller hosts. Returns the effective size (>= 1;
+    1 means 'no seq axis: train/eval with the plain full-window model')."""
+    n_seq = max(1, min(requested, devices_left))
+    if n_seq < requested:
+        import warnings
+
+        warnings.warn(
+            f"n_seq_shards={requested} exceeds the {devices_left} "
+            f"device(s) left by the other mesh axes; degrading to "
+            f"{n_seq}", stacklevel=3)
+    return n_seq
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (the device-resident panel, scalars)."""
     return NamedSharding(mesh, P())

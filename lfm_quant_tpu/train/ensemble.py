@@ -41,6 +41,7 @@ from lfm_quant_tpu.data.windows import DateBatchSampler
 from lfm_quant_tpu.parallel import (
     DATA_AXIS,
     SEED_AXIS,
+    SEQ_AXIS,
     make_mesh,
     shard_batch,
     state_sharding,
@@ -63,10 +64,6 @@ class EnsembleTrainer:
                  run_dir: Optional[str] = None, echo: bool = False):
         if cfg.n_seeds < 2:
             raise ValueError("EnsembleTrainer needs n_seeds >= 2")
-        if cfg.n_seq_shards > 1:
-            raise ValueError(
-                "n_seq_shards > 1 does not compose with the seed-vmapped "
-                "ensemble yet — train sequence-parallel models single-seed")
         self.cfg = cfg
         self.splits = splits
         self.run_dir = run_dir
@@ -74,7 +71,9 @@ class EnsembleTrainer:
         self.n_seeds = cfg.n_seeds
 
         # Mesh FIRST: seed axis as large as divides both n_seeds and the
-        # device count; data axis from config when devices remain. The
+        # device count; data axis from config when devices remain; then a
+        # seq axis from what's left (n_seq_shards > 1 — the full
+        # seed × data × seq composition; each degrades gracefully). The
         # inner Trainer then resolves model / gather / panel exactly once
         # against this mesh (no post-hoc attribute surgery).
         n_dev = jax.device_count()
@@ -84,9 +83,18 @@ class EnsembleTrainer:
                 n_seed_mesh = cand
                 break
         n_data = max(1, min(cfg.n_data_shards, n_dev // n_seed_mesh))
+        self._n_seq = 1
+        if cfg.n_seq_shards > 1:
+            # Seeds are the workload's signature axis; seq takes only the
+            # devices left over (degrading to 1 = plain full-window
+            # training — the shared contract in resolve_seq_shards).
+            from lfm_quant_tpu.parallel.mesh import resolve_seq_shards
+
+            self._n_seq = resolve_seq_shards(
+                cfg.n_seq_shards, n_dev // (n_seed_mesh * n_data))
         self.mesh = (
-            make_mesh(n_seed_mesh, n_data)
-            if n_seed_mesh * n_data > 1 else None
+            make_mesh(n_seed_mesh, n_data, n_seq=self._n_seq)
+            if n_seed_mesh * n_data * self._n_seq > 1 else None
         )
 
         self.seed_block = int(getattr(cfg, "seed_block", 0) or 0)
@@ -136,8 +144,14 @@ class EnsembleTrainer:
             self._jit_step = jax.jit(self._step_shards)
             self._jit_multi_step = jax.jit(self._multi_step_impl)
         else:
+            # Batch psums cover the data axis and, when present, the seq
+            # axis (per-shard sub-window gradients sum to the full-window
+            # gradient; the loss num/den seq duplication cancels —
+            # train/loop.py _shard_mapped has the argument).
+            step_axes = ((DATA_AXIS, SEQ_AXIS) if self._n_seq > 1
+                         else (DATA_AXIS,))
             self._vstep = jax.vmap(
-                functools.partial(self.inner._step_impl, axis=DATA_AXIS),
+                functools.partial(self.inner._step_impl, axis=step_axes),
                 in_axes=(0, None, 0, 0, 0))
             self._jit_step = jax.jit(self._shard_mapped(
                 self._step_shards, steps_axis=False))

@@ -18,7 +18,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import warnings
 from typing import (Any, Callable, Dict, NamedTuple, Optional, Tuple,
                     Union)
 
@@ -268,14 +267,10 @@ class Trainer:
                         "dropout is unsupported under sequence parallelism "
                         "(shard-local masks would decorrelate; see "
                         "models/transformer.py)")
-                self._n_seq = max(1, min(cfg.n_seq_shards,
-                                         jax.device_count() // n_data))
-                if self._n_seq < cfg.n_seq_shards:
-                    warnings.warn(
-                        f"n_seq_shards={cfg.n_seq_shards} exceeds the "
-                        f"devices left by the data axis "
-                        f"({jax.device_count()} // {n_data}); degrading "
-                        f"to {self._n_seq}", stacklevel=2)
+                from lfm_quant_tpu.parallel.mesh import resolve_seq_shards
+
+                self._n_seq = resolve_seq_shards(
+                    cfg.n_seq_shards, jax.device_count() // n_data)
                 if self._n_seq > 1 and d.window % self._n_seq:
                     raise ValueError(
                         f"window={d.window} must divide by "
@@ -283,10 +278,21 @@ class Trainer:
             mesh = (make_mesh(1, n_data, n_seq=self._n_seq)
                     if n_data * self._n_seq > 1 else None)
         elif cfg.n_seq_shards > 1:
-            raise ValueError(
-                "n_seq_shards > 1 requires the trainer's own mesh "
-                "(mesh='auto'); wrapper-provided meshes (ensembles) do "
-                "not carry a seq axis")
+            # Wrapper-provided mesh (EnsembleTrainer): the wrapper owns
+            # degradation and axis sizing — a mesh WITHOUT a seq axis (or
+            # no mesh at all, e.g. eval on a small host) means seq
+            # degraded to 1: train/eval with the plain full-window model.
+            if mesh is not None and SEQ_AXIS in mesh.shape:
+                if self._needs_rng:
+                    raise ValueError(
+                        "dropout is unsupported under sequence "
+                        "parallelism (shard-local masks would "
+                        "decorrelate; see models/transformer.py)")
+                self._n_seq = mesh.shape[SEQ_AXIS]
+                if self._n_seq > 1 and d.window % self._n_seq:
+                    raise ValueError(
+                        f"window={d.window} must divide by "
+                        f"n_seq_shards={self._n_seq}")
         self.mesh = mesh
         # Test/introspection alias: the mesh carrying the live seq axis.
         self.seq_mesh = mesh if self._n_seq > 1 else None

@@ -268,7 +268,7 @@ def test_seq_parallel_lru_training_from_config(tmp_path):
 
 def test_seq_parallel_config_validation(tmp_path):
     """The config-level guards: RNNs can't window-shard; window must
-    divide; no compose with data mesh / ensembles; dropout forbidden."""
+    divide; dropout forbidden; ensembles compose (seed × data × seq)."""
     import pytest as _pytest
 
     from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
@@ -309,8 +309,10 @@ def test_seq_parallel_config_validation(tmp_path):
             kind="transformer",
             kwargs={"dim": 16, "depth": 1, "heads": 2,
                     "dropout": 0.1})), splits)
-    with _pytest.raises(ValueError, match="ensemble"):
-        EnsembleTrainer(cfg(n_seeds=2), splits)
+    # Ensembles now COMPOSE with the seq axis (seed × data × seq) —
+    # construction must succeed and carry the seq mesh axis.
+    etr = EnsembleTrainer(cfg(n_seeds=2), splits)
+    assert "seq" in dict(etr.mesh.shape)
 
 
 def test_seq_parallel_resume_and_degrade(tmp_path):
@@ -395,3 +397,87 @@ def test_seq_parallel_composes_with_data_parallel(tmp_path):
     b = [h["train_loss"] for h in s_comp["history"]]
     np.testing.assert_allclose(b, a, rtol=2e-3)
     assert abs(s_comp["best_val_ic"] - s_plain["best_val_ic"]) < 0.05
+
+
+def test_seq_parallel_composes_with_ensemble(tmp_path):
+    """The full parallelism matrix: seed × data × seq on one mesh
+    (2 seeds × 2 data × 2 seq over the 8 virtual devices). The ensemble's
+    per-seed loss traces must match the same ensemble trained without the
+    seq axis (seeds/data orders identical; only the window sharding
+    changes)."""
+    import numpy as np
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
+
+    panel = synthetic_panel(n_firms=150, n_months=150, n_features=5,
+                            seed=18)
+
+    def cfg(n_seq, name):
+        return RunConfig(
+            name=name,
+            data=DataConfig(n_firms=150, n_months=150, n_features=5,
+                            window=8, dates_per_batch=4,
+                            firms_per_date=24),
+            model=ModelConfig(kind="lru",
+                              kwargs={"hidden": 16, "state_dim": 16,
+                                      "layers": 1}),
+            optim=OptimConfig(lr=3e-3, epochs=2, warmup_steps=5,
+                              loss="mse"),
+            n_seeds=2, n_data_shards=2, n_seq_shards=n_seq,
+            out_dir=str(tmp_path),
+        )
+
+    s_plain, tr_p, _ = run_ensemble_experiment(cfg(1, "ens_plain"),
+                                               panel=panel)
+    s_seq, tr_s, _ = run_ensemble_experiment(cfg(2, "ens_seq"),
+                                             panel=panel)
+    assert dict(tr_s.mesh.shape) == {"seed": 2, "data": 2, "seq": 2}
+    a = [h["train_loss"] for h in s_plain["history"]]
+    b = [h["train_loss"] for h in s_seq["history"]]
+    np.testing.assert_allclose(b, a, rtol=2e-3)
+    # Per-seed params match across the two runs too (seeds independent).
+    for x, y in zip(jax.tree.leaves(tr_p.state.params),
+                    jax.tree.leaves(tr_s.state.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-2, atol=2e-4)
+
+
+def test_seq_fully_degraded_ensemble_still_constructs(tmp_path):
+    """When seed×data consume every device, the seq axis degrades to 1
+    and the ensemble must construct and train with the plain full-window
+    model — NOT crash (the pod-trained-config-on-small-host contract)."""
+    import warnings as _warnings
+
+    import numpy as np
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+    panel = synthetic_panel(n_firms=120, n_months=150, n_features=5,
+                            seed=19)
+    splits = PanelSplits.by_date(panel, 197901, 198101)
+    cfg = RunConfig(
+        name="sp_degraded",
+        data=DataConfig(n_firms=120, n_months=150, n_features=5,
+                        window=8, dates_per_batch=4, firms_per_date=16),
+        model=ModelConfig(kind="lru",
+                          kwargs={"hidden": 16, "state_dim": 16,
+                                  "layers": 1}),
+        optim=OptimConfig(lr=3e-3, epochs=1, warmup_steps=2, loss="mse"),
+        n_seeds=4, n_data_shards=2, n_seq_shards=2,  # 4*2 = all 8 devices
+        out_dir=str(tmp_path),
+    )
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        tr = EnsembleTrainer(cfg, splits)
+    assert any("degrading" in str(w.message) for w in rec)
+    assert "seq" not in dict(tr.mesh.shape)  # fully degraded: 2-axis mesh
+    state = tr.init_state()
+    arrays = tr._stacked_batch([s.epoch(0) for s in tr.samplers])
+    state, ms = tr._jit_step(state, tr.dev, *arrays)
+    assert np.isfinite(float(np.asarray(ms["loss"]).mean()))
