@@ -176,3 +176,37 @@ def test_walkforward_nll_stitches_variances_and_total_std(tmp_path):
     rc = bt_cli.main(["--forecast-npz", str(tmp_path / "wf"),
                       "--quantile", "0.3", "--mode", "mean_minus_total_std"])
     assert rc == 0
+
+
+def test_walkforward_with_sequence_parallelism(panel, tmp_path):
+    """Walk-forward retraining composes with n_seq_shards: each fold's
+    trainer rebuilds the (data × seq) mesh and the stitched forecasts
+    stay strictly out of sample."""
+    cfg = dataclasses.replace(
+        _cfg(tmp_path),
+        model=ModelConfig(kind="transformer",
+                          kwargs={"dim": 16, "depth": 1, "heads": 2}),
+        n_seq_shards=4,
+    )
+    # A degrade warning would mean the seq axis silently collapsed and
+    # this test stopped exercising the composition — treat it as failure.
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.filterwarnings("error", message=".*degrading.*")
+        fc, valid, summary = run_walkforward(
+            cfg, panel=panel, start=198001, step_months=12, val_months=24,
+            n_folds=2)
+    assert summary["n_folds"] == 2
+    assert valid.any()
+    assert np.isfinite(fc[valid]).all()
+    # Strictly out of sample: valid cells only inside the stitched OOS
+    # range (boundary math as in test_walkforward_stitches_oos_only).
+    dates = panel.dates
+    lo = int(np.searchsorted(dates, month_add(198001, 24)))
+    hi = int(np.searchsorted(dates, month_add(198001, 24 + 2 * 12)))
+    assert valid[:, lo:hi].any()
+    assert not valid[:, :lo].any() and not valid[:, hi:].any()
+    # The seq-sharded transformer folds must still find signal OOS.
+    ic = np.corrcoef(fc[valid], panel.targets[valid])[0, 1]
+    assert ic > 0.0, ic
