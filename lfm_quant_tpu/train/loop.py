@@ -376,6 +376,34 @@ class Trainer:
                 self._multi_step_impl, steps_axis=True))
         self._jit_forward = jax.jit(self._forward_impl,
                                     static_argnames=("variance",))
+        # Month-sharded eval: under a data mesh the plain jitted forward
+        # would replicate the whole sweep on every device; shard_map over
+        # the stacked month axis makes eval/backtest scale with the data
+        # axis like training does (n_data× at pod scale). MC-dropout
+        # sampling keeps the plain path (per-chunk rng keys don't shard).
+        self._eval_sharded = (self.mesh is not None
+                              and self.mesh.shape[DATA_AXIS] > 1)
+        if self._eval_sharded:
+            import functools
+
+            from jax.sharding import PartitionSpec as P
+
+            sharded = functools.partial(
+                jax.shard_map, mesh=self.mesh,
+                in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS)),
+                check_vma=False)
+            self._jit_fwd_det = jax.jit(sharded(
+                functools.partial(self._forward_impl, axis=DATA_AXIS),
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P())))
+
+            def fwd_var(params, dev, fi, ti, w):
+                mean, var, _ = self._forward_impl(params, dev, fi, ti, w,
+                                                  variance=True)
+                return mean, var
+
+            self._jit_fwd_var = jax.jit(sharded(
+                fwd_var, out_specs=(P(DATA_AXIS), P(DATA_AXIS))))
 
     def _shard_mapped(self, impl, steps_axis: bool):
         """Wrap a step impl in shard_map over this trainer's mesh.
@@ -515,7 +543,7 @@ class Trainer:
         return jax.lax.scan(body, state, (fi, ti, w))
 
     def _forward_impl(self, params, dev: dict, firm_idx, time_idx, weight,
-                      rng=None, variance: bool = False):
+                      rng=None, variance: bool = False, axis=None):
         """Eval forward: returns (pred [D,Bf], per-month IC [D], mse scalar).
 
         Chunked over the date axis with ``lax.map``: eval sweeps stack ALL
@@ -528,6 +556,9 @@ class Trainer:
         ``variance`` (static) returns (mean, aleatoric variance, None)
         from a heteroscedastic head instead of (pred, IC, mse) — the
         uncertainty-aware-LFM prediction path (SURVEY.md §1 lineage).
+        ``axis``: mesh axis name when running inside the month-sharded
+        eval ``shard_map`` (see ``_forward_eval``) — the mse parts psum
+        over it so the scalar replicates.
         """
         if variance and rng is not None:
             raise ValueError("variance + MC-dropout sampling not supported")
@@ -579,7 +610,11 @@ class Trainer:
         pred = pred.reshape(nc * C, -1)[:M]
         ic = ic.reshape(-1)[:M]
         se, ws = se.reshape(-1)[:M], ws.reshape(-1)[:M]
-        mse = se.sum() / jnp.maximum(ws.sum(), 1e-12)
+        se_sum, ws_sum = se.sum(), ws.sum()
+        if axis is not None:
+            se_sum = jax.lax.psum(se_sum, axis)
+            ws_sum = jax.lax.psum(ws_sum, axis)
+        mse = se_sum / jnp.maximum(ws_sum, 1e-12)
         return pred, ic, mse
 
     # ---- public API --------------------------------------------------
@@ -622,14 +657,40 @@ class Trainer:
             return shard_batch(self.mesh, arrays, steps_axis=steps)
         return arrays
 
+    def _forward_eval(self, params, b: WindowIndex, variance: bool = False):
+        """Deterministic eval dispatch for a stacked [M, bf] batch: the
+        month-sharded path under a data mesh (months padded to the axis
+        size with weight-0 repeats, outputs sliced back), else the plain
+        jitted forward. Returns (pred, ic, mse) or (mean, var, None)."""
+        M = b.weight.shape[0]
+        fi = jnp.asarray(b.firm_idx)
+        ti = jnp.asarray(b.time_idx)
+        w = jnp.asarray(b.weight)
+        if not self._eval_sharded:
+            return self._jit_forward(params, self.dev, fi, ti, w,
+                                     variance=variance)
+        n_data = self.mesh.shape[DATA_AXIS]
+        pad = -M % n_data
+        if pad:
+            rep = lambda a: jnp.concatenate(
+                [a] + [a[-1:]] * pad, axis=0)
+            fi, ti = rep(fi), rep(ti)
+            w = jnp.concatenate([w, jnp.zeros_like(w[-1:])
+                                 .repeat(pad, axis=0)], axis=0)
+        args = shard_batch(self.mesh, (fi, ti, w))
+        if variance:
+            mean, var = self._jit_fwd_var(params, self.dev, *args)
+            return mean[:M], var[:M], None
+        pred, ic, mse = self._jit_fwd_det(params, self.dev, *args)
+        return pred[:M], ic[:M], mse
+
     def evaluate(self, state_params, sampler=None) -> Dict[str, float]:
         """Validation sweep in ONE dispatch: all eval months stacked into a
         single [M, bf] batch (rows = months, so per-month IC comes out of
-        the same [D, Bf] code path)."""
+        the same [D, Bf] code path; month-sharded over the data mesh)."""
         sampler = sampler or self.val_sampler
         b = sampler.stacked_cross_sections()
-        fi, ti, w = self._batch_args(b)
-        _, ic, mse = self._jit_forward(state_params, self.dev, fi, ti, w)
+        _, ic, mse = self._forward_eval(state_params, b)
         counts = b.weight.sum(axis=1)
         return {
             "ic": float(np.average(np.asarray(ic), weights=counts)),
@@ -741,7 +802,6 @@ class Trainer:
         )
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
         b = sampler.stacked_cross_sections()
-        fi, ti, w = self._batch_args(b)
         real = b.weight > 0  # [M, bf]
         rows = b.firm_idx[real]
         cols = np.broadcast_to(b.time_idx[:, None], b.firm_idx.shape)[real]
@@ -756,6 +816,7 @@ class Trainer:
             # own cached trace with dropout live and metrics skipped.
             out = np.zeros((mc_samples, panel.n_firms, panel.n_months),
                            np.float32)
+            fi, ti, w = self._batch_args(b)
             key = jax.random.key(mc_seed)
             for k in range(mc_samples):
                 pred, _, _ = self._jit_forward(
@@ -767,12 +828,12 @@ class Trainer:
         out = np.zeros((panel.n_firms, panel.n_months), np.float32)
         if return_variance:
             var_out = np.zeros_like(out)
-            pred, var, _ = self._jit_forward(
-                self.state.params, self.dev, fi, ti, w, variance=True)
+            pred, var, _ = self._forward_eval(self.state.params, b,
+                                              variance=True)
             out[rows, cols] = np.asarray(pred)[real]
             var_out[rows, cols] = np.asarray(var)[real]
             return out, var_out, out_valid
-        pred, _, _ = self._jit_forward(self.state.params, self.dev, fi, ti, w)
+        pred, _, _ = self._forward_eval(self.state.params, b)
         out[rows, cols] = np.asarray(pred)[real]
         return out, out_valid
 

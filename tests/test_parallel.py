@@ -257,3 +257,90 @@ def test_make_mesh_topology_path_spans_all_devices():
     assert m.shape == {"seed": 2, "data": 4}
     assert sorted(d.id for row in m.devices for d in row) == sorted(
         d.id for d in jax.devices())
+
+
+def test_month_sharded_eval_matches_unsharded(tmp_path):
+    """Under a data mesh the eval sweep shards the stacked month axis
+    (with weight-0 padding to the axis size) instead of replicating the
+    whole computation per device — evaluate() and predict() must match
+    the meshless trainer exactly on identical params."""
+    import dataclasses
+
+    import numpy as np
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.train import Trainer
+
+    panel = synthetic_panel(n_firms=120, n_months=151, n_features=5,
+                            seed=23)
+    splits = PanelSplits.by_date(panel, 197901, 198101)
+    cfg = RunConfig(
+        name="ev_shard",
+        data=DataConfig(n_firms=120, n_months=151, n_features=5,
+                        window=12, dates_per_batch=4, firms_per_date=24),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=3e-3, epochs=1, warmup_steps=2, loss="mse"),
+        n_data_shards=4,
+        out_dir=str(tmp_path),
+    )
+    meshed = Trainer(cfg, splits)
+    assert meshed._eval_sharded
+    plain = Trainer(dataclasses.replace(cfg, n_data_shards=1), splits,
+                    mesh=None)
+    state = plain.init_state()  # same seed → same params for both
+    meshed.state = plain.state = state
+
+    ev_m = meshed.evaluate(state.params)
+    ev_p = plain.evaluate(state.params)
+    assert ev_m["n_months"] == ev_p["n_months"]
+    np.testing.assert_allclose(ev_m["ic"], ev_p["ic"], rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(ev_m["mse"], ev_p["mse"], rtol=1e-5)
+
+    fm, vm = meshed.predict("test")
+    fp, vp = plain.predict("test")
+    np.testing.assert_array_equal(vm, vp)
+    np.testing.assert_allclose(fm[vm], fp[vp], rtol=1e-5, atol=1e-6)
+
+
+def test_month_sharded_eval_variance_path(tmp_path):
+    """The sharded heteroscedastic eval (predict(return_variance=True)
+    under a data mesh) must match the meshless trainer exactly."""
+    import dataclasses
+
+    import numpy as np
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.train import Trainer
+
+    panel = synthetic_panel(n_firms=100, n_months=151, n_features=5,
+                            seed=24)
+    splits = PanelSplits.by_date(panel, 197901, 198101)
+    cfg = RunConfig(
+        name="ev_var_shard",
+        data=DataConfig(n_firms=100, n_months=151, n_features=5,
+                        window=12, dates_per_batch=4, firms_per_date=24),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)},
+                          heteroscedastic=True),
+        optim=OptimConfig(lr=3e-3, epochs=1, warmup_steps=2, loss="nll"),
+        n_data_shards=4,
+        out_dir=str(tmp_path),
+    )
+    meshed = Trainer(cfg, splits)
+    assert meshed._eval_sharded
+    plain = Trainer(dataclasses.replace(cfg, n_data_shards=1), splits,
+                    mesh=None)
+    state = plain.init_state()
+    meshed.state = plain.state = state
+
+    fm, vm_var, vm = meshed.predict("test", return_variance=True)
+    fp, vp_var, vp = plain.predict("test", return_variance=True)
+    np.testing.assert_array_equal(vm, vp)
+    np.testing.assert_allclose(fm[vm], fp[vp], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(vm_var[vm], vp_var[vp], rtol=1e-5,
+                               atol=1e-7)
+    assert (vm_var[vm] > 0).all()
