@@ -329,9 +329,14 @@ class Trainer:
             min_cross_section=1, date_range=splits.val_range,
         )
         # Gather implementation (Pallas DMA gather needs a lane-padded
-        # panel, so it must be resolved before the device transfer). Eval
-        # runs outside shard_map → XLA gather whenever a mesh exists (it
-        # reads the lane-padded panel via the logical fp width).
+        # panel, so it must be resolved before the device transfer). Under
+        # a mesh the eval sweep keeps the XLA gather even though the
+        # month-sharded path (_forward_eval) does run inside shard_map
+        # where a pallas_call would be legal: the MC-dropout path still
+        # runs un-sharded (GSPMD), and one shared eval gather impl keeps
+        # the paths identical; promoting the sharded eval to the DMA
+        # gather is an un-measured on-chip optimization, not a correctness
+        # constraint.
         self._gather_impl = resolve_gather_impl(
             d.gather_impl, self.mesh, splits.panel, d.window)
         if self._n_seq > 1:

@@ -33,21 +33,26 @@ _POD = textwrap.dedent("""
                             min_history=60)
     splits = PanelSplits.by_date(panel, 197706, 197901)
 
-    def run(n_seeds, n_data, tag):
+    def run(n_seeds, n_data, tag, n_seq=1, kind="lstm"):
+        kwargs = ({"hidden": 8} if kind == "lstm"
+                  else {"hidden": 8, "state_dim": 8, "layers": 1})
         cfg = RunConfig(
             name=f"pod_{tag}",
             data=DataConfig(n_firms=96, n_months=120, n_features=4,
                             window=8, dates_per_batch=max(2, n_data),
                             firms_per_date=8),
-            model=ModelConfig(kind="lstm", kwargs={"hidden": 8}),
+            model=ModelConfig(kind=kind, kwargs=kwargs),
             optim=OptimConfig(lr=1e-3, epochs=1, warmup_steps=1,
                               loss="mse"),
-            n_seeds=n_seeds, n_data_shards=n_data,
+            n_seeds=n_seeds, n_data_shards=n_data, n_seq_shards=n_seq,
         )
         tr = EnsembleTrainer(cfg, splits)
         assert tr.mesh is not None
-        assert dict(tr.mesh.shape) == {"seed": min(n_seeds, 64 // n_data),
-                                       "data": n_data}, tr.mesh.shape
+        want = {"seed": min(n_seeds, 64 // (n_data * n_seq)),
+                "data": n_data}
+        if n_seq > 1:
+            want["seq"] = n_seq
+        assert dict(tr.mesh.shape) == want, tr.mesh.shape
         state = tr.init_state()
         # The stacked state's seed axis must actually shard over the mesh:
         # spec pins axis 0 to 'seed', and the leaf spans the full mesh
@@ -70,6 +75,9 @@ _POD = textwrap.dedent("""
     tr64, state64 = run(64, 1, "seed64x1")
     # 8 x 8 two-axis mesh: 8-seed blocks x 8-way data parallelism.
     run(8, 8, "seed8x8")
+    # Full parallelism matrix at pod width: 4 seeds x 4 data x 4 seq
+    # (the LRU's distributed scan carries the window sharding).
+    run(4, 4, "seed4x4x4", n_seq=4, kind="lru")
 
     # Stacked checkpoint at pod width: save the 64-seed state, restore,
     # re-place on the mesh, and step again. Written under the cwd (the
@@ -101,5 +109,5 @@ def test_pod_shape_64_devices(tmp_path):
              "PYTHONPATH": ":".join(sys.path)},
         capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    for tag in ("seed64x1 OK", "seed8x8 OK", "ckpt64 OK"):
+    for tag in ("seed64x1 OK", "seed8x8 OK", "seed4x4x4 OK", "ckpt64 OK"):
         assert tag in proc.stdout, proc.stdout
