@@ -261,6 +261,118 @@ def test_bench_ladder_dates_override(monkeypatch):
     assert cfg.data.dates_per_batch == 8 and cfg.n_data_shards == 8
 
 
+@pytest.mark.fast
+def test_bench_wedged_tunnel_emits_status_record(monkeypatch, capsys):
+    """A wedged tunnel must still put a machine-parseable record on stdout
+    (round 3's driver capture was rc=1/parsed=null because only stderr
+    probe chatter preceded the timeout). Under a fake always-hanging probe
+    subprocess, bench.main() must give up INSIDE the wait window, emit
+    {"metric": "bench_status", "status": "tunnel_wedged", ...}, and exit
+    nonzero — the TERM-then-KILL escalation path included."""
+    import json as _json
+    import subprocess
+    import time as _time
+
+    import bench as bench_mod
+
+    killed = []
+
+    class HangingPopen:
+        def __init__(self, *a, **kw):
+            self.returncode = None
+
+        def communicate(self, timeout=None):
+            if timeout is not None and timeout > 0.2:
+                raise subprocess.TimeoutExpired("probe", timeout)
+            return "", ""  # post-SIGKILL reap (timeout=None)
+
+        def terminate(self):
+            pass
+
+        def kill(self):
+            killed.append(True)
+
+    monkeypatch.setenv("LFM_BENCH_WAIT_S", "1")
+    monkeypatch.delenv("LFM_BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.setattr(subprocess, "Popen", HangingPopen)
+    t0 = _time.monotonic()
+    rc = bench_mod.main()
+    took = _time.monotonic() - t0
+    assert rc == 1
+    assert took < 30  # gave up inside the window, not the driver timebox
+    assert killed  # SIGTERM-immune probe was SIGKILLed (advisor pattern)
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    rec = _json.loads(lines[-1])
+    assert rec["metric"] == "bench_status"
+    assert rec["status"] == "tunnel_wedged"
+    assert rec["unit"] == "status" and rec["value"] == 0.0
+    assert rec["probe_attempts"] >= 1
+
+
+@pytest.mark.fast
+def test_bench_status_distinguishes_env_error_and_crash(monkeypatch, capsys):
+    """The machine-readable status field must not cry 'tunnel' for
+    non-tunnel failures: an instant probe exit (broken env) is
+    probe_env_error, and an exception escaping the harness itself still
+    lands a bench_error record — no exit path may skip the record."""
+    import json as _json
+    import subprocess
+
+    import bench as bench_mod
+
+    class InstantFailPopen:
+        def __init__(self, *a, **kw):
+            self.returncode = 1
+
+        def communicate(self, timeout=None):
+            return "", "ModuleNotFoundError: no module named 'jax'"
+
+        def terminate(self):
+            pass
+
+        def kill(self):
+            pass
+
+    monkeypatch.delenv("LFM_BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.setattr(subprocess, "Popen", InstantFailPopen)
+    assert bench_mod.main() == 1
+    rec = _json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert rec["status"] == "probe_env_error"
+
+    # Harness bug (malformed env var) → bench_error via the outer guard.
+    monkeypatch.setenv("LFM_BENCH_WAIT_S", "not-a-number")
+    assert bench_mod.main() == 1
+    rec = _json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert rec["status"] == "bench_error" and rec["stage"] == "harness"
+
+
+@pytest.mark.fast
+def test_bench_watchdog_kills_postprobe_hang():
+    """A tunnel that wedges AFTER the probe passes hangs in
+    uninterruptible backend init — only the watchdog thread's os._exit
+    can still deliver the record. Simulate: arm the watchdog, hang the
+    main thread; the process must die quickly with a bench_timeout JSON
+    record on stdout."""
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    code = (
+        "import time, bench\n"
+        "bench._arm_watchdog(0.5)\n"
+        "time.sleep(30)\n"  # stand-in for the uninterruptible hang
+    )
+    proc = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=20, cwd=repo_root,
+    )
+    assert proc.returncode == 1
+    rec = _json.loads(proc.stdout.splitlines()[-1])
+    assert rec["status"] == "bench_timeout"
+
+
 def test_measure_eval_counts_real_firm_months(panel, tmp_path, monkeypatch):
     """bench.measure_eval's firm-month accounting, pinned exactly: with a
     frozen 2-second clock, rate == (real val weights × window [× seeds]) / 2
