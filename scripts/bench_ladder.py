@@ -26,9 +26,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import (  # noqa: E402
     V5E_BF16_PEAK,
+    eval_path,
     measure_ensemble_trainer,
     measure_eval,
     measure_trainer,
+    persist_row,
 )
 
 
@@ -193,6 +195,7 @@ def bench_config(name: str):
         "mfu_pct": round(100.0 * eval_value * (flops / 3.0)
                          / V5E_BF16_PEAK, 2),
         "config": cfg.name,
+        "eval_path": eval_path(trainer),
         **extras,
     }
 
@@ -201,7 +204,11 @@ def main(argv) -> int:
     names = argv or ["c1", "c2", "c3", "c4", "c5", "lru", "lru64", "lc"]
     for name in names:
         for rec in bench_config(name):
+            # Print AND persist per record, not per config: a tunnel death
+            # mid-eval must not lose the train row already measured (the
+            # generator yields train first for the same reason).
             print(json.dumps(rec), flush=True)
+            persist_row(rec)
     return 0
 
 

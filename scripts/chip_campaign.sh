@@ -18,9 +18,16 @@ echo "=== campaign start $(date) ===" | tee -a "$LOG"
 step() {
   name=$1; shift
   echo "--- $name: $* ($(date +%H:%M:%S))" | tee -a "$LOG"
-  timeout "$TMO" "$@" >> "$LOG" 2>&1
+  # -k 15: a step wedged in backend claim/init ignores SIGTERM — without
+  # the SIGKILL escalation the unattended campaign would hang forever on
+  # exactly the failure mode it exists to route around.
+  timeout -k 15 "$TMO" "$@" >> "$LOG" 2>&1
   rc=$?
   echo "--- $name rc=$rc" | tee -a "$LOG"
+  # Measurements persist to BENCH_ROWS.jsonl as they land; refresh the
+  # BASELINE.md view after every step so even a mid-campaign re-wedge
+  # leaves the table current up to the last completed step.
+  python scripts/regen_baseline.py >> "$LOG" 2>&1 || true
   case "$name" in
     c1diag*|seeds64*|sweep*|c3-fullD|ladder-lc) ;;  # expected-risky: don't abort
     *) if [ $rc -ne 0 ]; then

@@ -293,6 +293,7 @@ def test_bench_wedged_tunnel_emits_status_record(monkeypatch, capsys):
             killed.append(True)
 
     monkeypatch.setenv("LFM_BENCH_WAIT_S", "1")
+    monkeypatch.setenv("LFM_BENCH_NO_PERSIST", "1")  # keep the repo ledger clean
     monkeypatch.delenv("LFM_BENCH_SKIP_PROBE", raising=False)
     monkeypatch.setattr(subprocess, "Popen", HangingPopen)
     t0 = _time.monotonic()
@@ -334,6 +335,7 @@ def test_bench_status_distinguishes_env_error_and_crash(monkeypatch, capsys):
             pass
 
     monkeypatch.delenv("LFM_BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.setenv("LFM_BENCH_NO_PERSIST", "1")
     monkeypatch.setattr(subprocess, "Popen", InstantFailPopen)
     assert bench_mod.main() == 1
     rec = _json.loads(capsys.readouterr().out.splitlines()[-1])
@@ -367,10 +369,60 @@ def test_bench_watchdog_kills_postprobe_hang():
     proc = subprocess.run(
         [_sys.executable, "-c", code], capture_output=True, text=True,
         timeout=20, cwd=repo_root,
+        # NO_PERSIST: the fire path must not append test records to the
+        # repo ledger (and on a wedged axon tunnel a backend query from
+        # the timer thread would hang — persist_row guards it, but the
+        # test should not depend on that guard).
+        env={**_os.environ, "LFM_BENCH_NO_PERSIST": "1"},
     )
     assert proc.returncode == 1
     rec = _json.loads(proc.stdout.splitlines()[-1])
     assert rec["status"] == "bench_timeout"
+
+
+@pytest.mark.fast
+def test_bench_rows_persist_and_regen(tmp_path, monkeypatch, capsys):
+    """The measurement ledger: _emit/_emit_status append to
+    BENCH_ROWS.jsonl the moment a record exists (a mid-campaign re-wedge
+    must not lose captured rows), and regen_baseline collapses the ledger
+    latest-per-key into the BASELINE.md table view."""
+    import json as _json
+    import os as _os
+
+    import bench as bench_mod
+
+    ledger = tmp_path / "rows.jsonl"
+    monkeypatch.setenv("LFM_BENCH_ROWS", str(ledger))
+    monkeypatch.delenv("LFM_BENCH_NO_PERSIST", raising=False)
+
+    bench_mod._emit("train_throughput_c2_lstm", 1000.0, 5.0)
+    bench_mod._emit("train_throughput_c5_ensemble", 2000.0, 7.0, n_seeds=16)
+    bench_mod._emit("train_throughput_c5_ensemble", 2400.0, 8.0, n_seeds=16)
+    bench_mod._emit("train_throughput_c5_ensemble", 3000.0, 9.0, n_seeds=64)
+    bench_mod._emit_status("tunnel_wedged", detail="probe timeout")
+    capsys.readouterr()
+
+    rows = [_json.loads(ln) for ln in ledger.read_text().splitlines()]
+    assert len(rows) == 5
+    assert all("ts" in r for r in rows)
+
+    monkeypatch.syspath_prepend(
+        _os.path.join(_os.path.dirname(__file__), "..", "scripts"))
+    import regen_baseline
+
+    table = regen_baseline.render_table(regen_baseline.load_rows(str(ledger)))
+    # Latest-per-key: the 16-seed row shows 2,400 (not 2,000); the 64-seed
+    # geometry is its own line; the outage shows as a status footnote.
+    assert "2,400.0" in table and "2,000.0" not in table
+    assert "3,000.0" in table and "n_seeds=64" in table
+    assert "1,000.0" in table
+    assert "tunnel_wedged" in table
+
+    # Persistence must never kill a measurement run: unwritable path.
+    monkeypatch.setenv("LFM_BENCH_ROWS", str(tmp_path / "nodir" / "x.jsonl"))
+    bench_mod._emit("train_throughput_c2_lstm", 1.0, 0.1)  # no raise
+    out = capsys.readouterr()
+    assert "could not persist" in out.err
 
 
 def test_measure_eval_counts_real_firm_months(panel, tmp_path, monkeypatch):
@@ -406,3 +458,14 @@ def test_measure_eval_counts_real_firm_months(panel, tmp_path, monkeypatch):
     ev = bench_mod.measure_eval(etr, reps=1)
     assert ev == pytest.approx(efm / 2.0)
     assert efm == pytest.approx(2.0 * fm)  # the seed stack doubles the count
+
+    # Under a data mesh the PRODUCTION eval program is the month-sharded
+    # _forward_eval — measure_eval must time that path (round-3 advisor),
+    # record it as such, and count the same real firm-months.
+    scfg = tiny_cfg(n_data_shards=2, out_dir=str(tmp_path))
+    str_ = Trainer(scfg, splits)
+    assert bench_mod.eval_path(str_) == "month_sharded"
+    assert bench_mod.eval_path(tr) == "replicated"
+    monkeypatch.setattr(bench_mod.time, "perf_counter", frozen_clock())
+    sv = bench_mod.measure_eval(str_, reps=1)
+    assert sv == pytest.approx(fm / 2.0)
