@@ -266,8 +266,12 @@ def bench_c2() -> None:
     value = measure_trainer(trainer)
     flops = _lstm_train_flops_per_fm(
         cfg.model.kwargs.get("hidden", 128), d.n_features)
+    # RESOLVED impls, so A/B runs (LFM_BENCH_SCAN_IMPL / _GATHER_IMPL)
+    # land on distinct ledger keys instead of overwriting each other.
     _emit("train_throughput_c2_lstm", value,
-          100.0 * value * flops / V5E_BF16_PEAK)
+          100.0 * value * flops / V5E_BF16_PEAK,
+          scan_impl=trainer.model.scan_impl,
+          gather_impl=trainer._gather_impl)
 
 
 def bench_c5_ensemble() -> None:
@@ -301,7 +305,10 @@ def bench_c5_ensemble() -> None:
     _emit("train_throughput_c5_ensemble", value,
           100.0 * value * flops / V5E_BF16_PEAK,
           n_seeds=n_seeds,
-          per_seed_fm_s=round(value / n_seeds, 1))
+          per_seed_fm_s=round(value / n_seeds, 1),
+          scan_impl=trainer.inner.model.scan_impl,
+          gather_impl=trainer.inner._gather_impl,
+          **({"seed_block": seed_block} if seed_block else {}))
 
 
 def _tunnel_probe(wait_s: float = 420.0) -> dict:

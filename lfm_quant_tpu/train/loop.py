@@ -334,9 +334,7 @@ class Trainer:
         # month-sharded path (_forward_eval) does run inside shard_map
         # where a pallas_call would be legal: the MC-dropout path still
         # runs un-sharded (GSPMD), and one shared eval gather impl keeps
-        # the paths identical; promoting the sharded eval to the DMA
-        # gather is an un-measured on-chip optimization, not a correctness
-        # constraint.
+        # the paths identical.
         self._gather_impl = resolve_gather_impl(
             d.gather_impl, self.mesh, splits.panel, d.window)
         if self._n_seq > 1:
@@ -347,6 +345,17 @@ class Trainer:
             self._gather_impl = "xla"
         self._eval_gather_impl = (
             self._gather_impl if self.mesh is None else "xla")
+        # Sharded-eval gather promotion, flag-gated until measured on
+        # chip: inside the month-sharded shard_map each shard is locally
+        # un-partitioned, so the DMA gather is as legal there as in the
+        # train step. LFM_EVAL_SHARDED_GATHER=pallas opts the sharded
+        # dispatches (axis != None in _forward_impl) into it when the
+        # panel is already lane-padded for the train gather; the GSPMD
+        # paths (MC-dropout sampling, no-mesh eval) are untouched.
+        self._eval_gather_sharded = self._eval_gather_impl
+        if (os.environ.get("LFM_EVAL_SHARDED_GATHER") == "pallas"
+                and self._gather_impl == "pallas"):
+            self._eval_gather_sharded = "pallas"
         self._fp = splits.panel.n_features + 1  # logical packed width
         # ONE device-resident copy of the full panel serves training,
         # eval and inference (PanelSplits are anchor ranges, not slices).
@@ -584,7 +593,9 @@ class Trainer:
         def chunk(args):
             fi, ti, w, *key = args
             x, m = self._gather(dev["xm"], fi, ti,
-                                impl=self._eval_gather_impl)
+                                impl=(self._eval_gather_sharded
+                                      if axis is not None
+                                      else self._eval_gather_impl))
             out = self._apply(params, x, m, model=self.eval_model,
                               rng=key[0] if key else None)
             if variance:

@@ -26,6 +26,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import (  # noqa: E402
     V5E_BF16_PEAK,
+    _backend_name,
     eval_path,
     measure_ensemble_trainer,
     measure_eval,
@@ -175,6 +176,16 @@ def bench_config(name: str):
              "(compile on first dispatch)")
         value = measure_trainer(
             trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "30")))
+    # The RESOLVED impls (auto → xla|pallas|pallas_fused happened at
+    # build time) and the backend, per row: a ledger row must say which
+    # program ran where — A/B rows differ only by these fields, and a CPU
+    # smoke run must never collapse onto a chip row under regen's
+    # latest-per-key rule.
+    inner = getattr(trainer, "inner", trainer)
+    extras["backend"] = _backend_name()
+    extras["gather_impl"] = inner._gather_impl
+    if cfg.model.kind in ("lstm", "gru"):
+        extras["scan_impl"] = inner.model.scan_impl
     flops = _flops_per_fm(cfg)
     yield {
         "metric": f"train_throughput_{name}",
@@ -188,6 +199,13 @@ def bench_config(name: str):
     _log(f"{name}: measuring eval sweep")
     eval_value = measure_eval(trainer)
     _log(f"{name}: done")
+    # The EVAL dispatch's own gather (promotion flag included) — not the
+    # train gather: the A/B rows the promotion flag exists for must get
+    # distinct regen keys.
+    eval_extras = dict(extras)
+    eval_extras["gather_impl"] = (
+        inner._eval_gather_sharded if eval_path(trainer) == "month_sharded"
+        else inner._eval_gather_impl)
     yield {
         "metric": f"eval_throughput_{name}",
         "value": round(eval_value, 1),
@@ -196,7 +214,7 @@ def bench_config(name: str):
                          / V5E_BF16_PEAK, 2),
         "config": cfg.name,
         "eval_path": eval_path(trainer),
-        **extras,
+        **eval_extras,
     }
 
 

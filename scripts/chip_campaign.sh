@@ -53,6 +53,13 @@ TMO=600 step bench env LFM_BENCH_SKIP_PROBE=1 python bench.py
 # full-universe rank-IC (Bf ≈ 8192) — watch HBM; c2's eval row rides on
 # the ladder too.
 TMO=600 step ladder-c2 python scripts/bench_ladder.py c2
+# Eval-gather A/B at c2 (round-3 verdict item 7): the default row above
+# measures eval with the DMA gather (auto→pallas on TPU, single-chip
+# eval is unsharded so _eval_gather_impl == _gather_impl); this row is
+# the XLA-gather twin. Inside the month-sharded shard_map each shard
+# runs exactly this single-device eval program on its month subset, so
+# the pair decides LFM_EVAL_SHARDED_GATHER for multi-chip meshes too.
+TMO=600 step ladder-c2-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c2
 # c3 at the REAL per-shard batch (8-way date sharding → D=1 per chip);
 # the full-D single-chip variant follows as a risky extra (OOM risk).
 TMO=900 step ladder-c3 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c3

@@ -199,6 +199,41 @@ def test_shard_map_multi_step_pallas(lstm_panel, tmp_path):
                                    rtol=1e-3, atol=1e-5)
 
 
+def test_sharded_eval_pallas_gather_promotion(lstm_panel, tmp_path,
+                                              monkeypatch):
+    """LFM_EVAL_SHARDED_GATHER=pallas routes ONLY the month-sharded eval
+    dispatches (inside shard_map, where the DMA gather is legal) through
+    the Pallas gather; the promoted sweep must reproduce the default
+    XLA-gather sharded eval, and the GSPMD eval paths must stay on XLA.
+    The flag exists so the on-chip campaign can measure the promotion
+    (round-3 verdict: an unmeasured optimization) without code edits."""
+    splits = PanelSplits.by_date(lstm_panel, 198001, 198201)
+    t_def = Trainer(_pallas_cfg(4, tmp_path / "a", ("pallas", "pallas")),
+                    splits)
+    monkeypatch.setenv("LFM_EVAL_SHARDED_GATHER", "pallas")
+    t_pro = Trainer(_pallas_cfg(4, tmp_path / "b", ("pallas", "pallas")),
+                    splits)
+    assert t_def._eval_gather_sharded == "xla"
+    assert t_pro._eval_gather_sharded == "pallas"
+    assert t_pro._eval_gather_impl == "xla"  # GSPMD paths untouched
+
+    # A trainer whose panel is NOT lane-padded must refuse the promotion.
+    t_xla = Trainer(_pallas_cfg(4, tmp_path / "c", ("xla", "xla")), splits)
+    assert t_xla._eval_gather_sharded == "xla"
+
+    s = t_def.init_state()
+    v_def = t_def.evaluate(s.params)
+    v_pro = t_pro.evaluate(s.params)
+    assert v_pro["ic"] == pytest.approx(v_def["ic"], abs=1e-5)
+    assert v_pro["mse"] == pytest.approx(v_def["mse"], rel=1e-5)
+    # The predict/backtest forecasts ride the same dispatch: full parity.
+    b = t_def.val_sampler.stacked_cross_sections()
+    p_def, _, _ = t_def._forward_eval(s.params, b)
+    p_pro, _, _ = t_pro._forward_eval(s.params, b)
+    np.testing.assert_allclose(np.asarray(p_def), np.asarray(p_pro),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_ensemble_shard_map_pallas_matches_xla(lstm_panel, tmp_path):
     """vmap(seeds) ∘ shard_map(seed × data) ∘ Pallas kernels: the stacked
     ensemble step with per-shard Pallas must match the same ensemble on
