@@ -412,8 +412,12 @@ class Trainer:
                 out_specs=(P(DATA_AXIS), P(DATA_AXIS), P())))
 
             def fwd_var(params, dev, fi, ti, w):
+                # axis marks this as a SHARDED dispatch (gather promotion
+                # applies); the variance branch returns before the mse
+                # psum, so the axis is never collectively reduced here.
                 mean, var, _ = self._forward_impl(params, dev, fi, ti, w,
-                                                  variance=True)
+                                                  variance=True,
+                                                  axis=DATA_AXIS)
                 return mean, var
 
             self._jit_fwd_var = jax.jit(sharded(

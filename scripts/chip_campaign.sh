@@ -56,9 +56,12 @@ TMO=600 step ladder-c2 python scripts/bench_ladder.py c2
 # Eval-gather A/B at c2 (round-3 verdict item 7): the default row above
 # measures eval with the DMA gather (auto→pallas on TPU, single-chip
 # eval is unsharded so _eval_gather_impl == _gather_impl); this row is
-# the XLA-gather twin. Inside the month-sharded shard_map each shard
-# runs exactly this single-device eval program on its month subset, so
-# the pair decides LFM_EVAL_SHARDED_GATHER for multi-chip meshes too.
+# the XLA-gather twin. Caveat for the multi-chip read-across: the
+# month-sharded eval runs the force_xla_scan twin MODEL, while this
+# single-chip pair runs the Pallas-scan model — so the pair measures
+# the gather delta only as a PROXY (same chunked gather, different scan
+# program); it informs LFM_EVAL_SHARDED_GATHER but a mesh-resident
+# re-measurement should confirm before hard-defaulting the promotion.
 TMO=600 step ladder-c2-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c2
 # c3 at the REAL per-shard batch (8-way date sharding → D=1 per chip);
 # the full-D single-chip variant follows as a risky extra (OOM risk).

@@ -207,12 +207,23 @@ def test_sharded_eval_pallas_gather_promotion(lstm_panel, tmp_path,
     XLA-gather sharded eval, and the GSPMD eval paths must stay on XLA.
     The flag exists so the on-chip campaign can measure the promotion
     (round-3 verdict: an unmeasured optimization) without code edits."""
+    import dataclasses
+
     splits = PanelSplits.by_date(lstm_panel, 198001, 198201)
+
+    def het(sub):  # heteroscedastic twin: the sharded VARIANCE dispatch
+        c = _pallas_cfg(4, tmp_path / sub, ("pallas", "pallas"))
+        return dataclasses.replace(
+            c, model=dataclasses.replace(c.model, heteroscedastic=True))
+
+    monkeypatch.delenv("LFM_EVAL_SHARDED_GATHER", raising=False)  # hermetic
     t_def = Trainer(_pallas_cfg(4, tmp_path / "a", ("pallas", "pallas")),
                     splits)
+    t_hdef = Trainer(het("ha"), splits)
     monkeypatch.setenv("LFM_EVAL_SHARDED_GATHER", "pallas")
     t_pro = Trainer(_pallas_cfg(4, tmp_path / "b", ("pallas", "pallas")),
                     splits)
+    t_hpro = Trainer(het("hb"), splits)
     assert t_def._eval_gather_sharded == "xla"
     assert t_pro._eval_gather_sharded == "pallas"
     assert t_pro._eval_gather_impl == "xla"  # GSPMD paths untouched
@@ -232,6 +243,16 @@ def test_sharded_eval_pallas_gather_promotion(lstm_panel, tmp_path,
     p_pro, _, _ = t_pro._forward_eval(s.params, b)
     np.testing.assert_allclose(np.asarray(p_def), np.asarray(p_pro),
                                rtol=1e-5, atol=1e-6)
+
+    # The sharded VARIANCE dispatch (fwd_var) promotes too — it marks
+    # itself with the mesh axis exactly like the deterministic one.
+    hs = t_hdef.init_state()
+    m_def, v_def_, _ = t_hdef._forward_eval(hs.params, b, variance=True)
+    m_pro, v_pro_, _ = t_hpro._forward_eval(hs.params, b, variance=True)
+    np.testing.assert_allclose(np.asarray(m_def), np.asarray(m_pro),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v_def_), np.asarray(v_pro_),
+                               rtol=1e-5, atol=1e-7)
 
 
 def test_ensemble_shard_map_pallas_matches_xla(lstm_panel, tmp_path):
