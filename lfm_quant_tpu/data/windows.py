@@ -314,7 +314,8 @@ class DateBatchSampler:
             )
 
 
-def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int) -> str:
+def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int,
+                        bf16: bool = False) -> str:
     """Resolve a gather_impl config ("auto"|"xla"|"pallas") against the
     execution context: the Pallas DMA gather (ops/pallas_gather.py) needs
     a real TPU and a panel long enough for an aligned DMA span.
@@ -324,6 +325,17 @@ def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int) -> str:
     is locally un-partitioned and runs its own pallas_call. Only the eval
     forward stays GSPMD-partitioned under a mesh — trainers route it to
     the XLA gather separately (``Trainer._eval_gather_impl``).
+
+    ``bf16``: the packed panel's compute dtype (cfg.model.bf16). "auto"
+    resolves the f32 panel to the XLA gather: every successful on-chip
+    gather to date was bf16, while the first f32 DMA-gather attempt was
+    the first victim of the 2026-07-30 tunnel wedge and remains the
+    prime suspect (scripts/diag_c1.py — the geometry LOWERS cleanly, so
+    the failure is compile/runtime-side). Until the staged on-chip
+    diagnosis clears it, the DEFAULT must not route users onto the
+    suspect path; an explicit ``gather_impl="pallas"`` still forces it
+    (that is how the diagnosis itself runs). The parameter FAILS CLOSED:
+    callers that don't state the dtype get the safe XLA resolution.
     """
     import jax
 
@@ -335,6 +347,7 @@ def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int) -> str:
         return impl
     del mesh  # kept in the signature: callers resolve per execution context
     ok = (jax.default_backend() == "tpu"
+          and bf16
           and panel.n_months >= window
           and _aligned_span(window, padded_months(panel.n_months)) is not None)
     return "pallas" if ok else "xla"

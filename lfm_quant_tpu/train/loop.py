@@ -336,7 +336,8 @@ class Trainer:
         # runs un-sharded (GSPMD), and one shared eval gather impl keeps
         # the paths identical.
         self._gather_impl = resolve_gather_impl(
-            d.gather_impl, self.mesh, splits.panel, d.window)
+            d.gather_impl, self.mesh, splits.panel, d.window,
+            bf16=cfg.model.bf16)
         if self._n_seq > 1:
             # Sequence-parallel steps gather only the shard's SUB-window
             # (window // n_seq months) — the Pallas DMA gather's aligned

@@ -100,10 +100,13 @@ TMO=900 step sweep-blocks python scripts/sweep_rnn_blocks.py
 probe after-sweep
 
 # The c1 suspect, isolated and LAST (see scripts/diag_c1.py): first the
-# XLA gather (rules out the MLP program), then the Pallas DMA gather.
+# XLA gather (rules out the MLP program), then the f32 Pallas DMA gather
+# — EXPLICIT "pallas": auto now safety-gates f32 to the XLA gather, so
+# "-" would no longer probe the suspect. The ladder-c1 row itself runs
+# the safe default (auto→xla for f32) and cannot re-trip the wedge.
 TMO=420 step c1diag-xla python scripts/diag_c1.py xla 5
 probe after-c1diag-xla
-TMO=420 step c1diag-pallas python scripts/diag_c1.py - 5
+TMO=420 step c1diag-pallas python scripts/diag_c1.py pallas 5
 probe after-c1diag-pallas
 TMO=600 step c1 python scripts/bench_ladder.py c1
 

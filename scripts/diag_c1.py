@@ -9,10 +9,15 @@ per-stage timing prints and all-thread stack dumps to stderr every 60 s,
 so a recurrence pinpoints the exact blocking frame.
 
 Run:  python scripts/diag_c1.py [gather_impl|-] [k]
-  gather_impl: xla | pallas | - (config default; auto→pallas on TPU).
-    Diagnose with "xla" FIRST (rules out the MLP program), then "-"
-    (the Pallas DMA gather — the prime suspect: c1 is the only f32
-    ladder config, and only bf16 gathers have ever run on chip).
+  gather_impl: xla | pallas | - (config default; NOTE: "auto" now
+    resolves f32 panels to the XLA gather — resolve_gather_impl's
+    safety gate added after this suspect was identified — so "-" is a
+    safe-default run, and the suspect probe must say "pallas"
+    EXPLICITLY).
+    Diagnose with "xla" FIRST (rules out the MLP program), then
+    "pallas" (the f32 Pallas DMA gather — the prime suspect: c1 is the
+    only f32 ladder config, and only bf16 gathers have ever run on
+    chip).
   k: steps per dispatch (default 5).
 DIAG_CPU=1 forces the CPU backend (sanity check of the script itself).
 """

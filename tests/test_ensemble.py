@@ -162,6 +162,7 @@ def test_total_std_mode_requires_variance():
         aggregate_ensemble(fc, valid, "mean_minus_total_std")
 
 
+@pytest.mark.nightly
 def test_lru_ensemble_trains(panel, tmp_path):
     """The associative-scan LRU composes with the seed-vmapped ensemble
     (generic batching over the scan) — guard the kind=lru + n_seeds>1
@@ -176,6 +177,7 @@ def test_lru_ensemble_trains(panel, tmp_path):
     assert not np.allclose(stacked[0][valid], stacked[1][valid])
 
 
+@pytest.mark.nightly
 def test_seed_block_matches_unblocked(panel, tmp_path):
     """seed_block is a pure memory-shape knob: scanning the seed stack in
     blocks must reproduce the all-at-once vmapped step (seeds are

@@ -146,6 +146,36 @@ def test_device_panel_lane_pad_pads_months():
     assert resolve_gather_impl("auto", None, panel, W) in ("xla", "pallas")
 
 
+def test_resolve_gather_auto_refuses_f32_on_tpu(monkeypatch):
+    """The f32 DMA gather is the standing tunnel-wedge suspect
+    (scripts/diag_c1.py): until the on-chip diagnosis clears it, "auto"
+    must route f32 panels to the XLA gather even on TPU, while bf16
+    keeps the fast path and an explicit "pallas" is always honored (the
+    diagnosis itself needs the override)."""
+    import jax
+
+    import lfm_quant_tpu.data.windows as win
+
+    T = 240
+    valid = np.ones((N_FIRMS, T), bool)
+    panel = Panel(
+        features=np.zeros((N_FIRMS, T, N_FEAT), np.float32), valid=valid,
+        targets=np.zeros((N_FIRMS, T), np.float32),
+        target_valid=valid.copy(),
+        returns=np.zeros((N_FIRMS, T), np.float32),
+        dates=np.arange(T, dtype=np.int32),
+        firm_ids=np.arange(N_FIRMS, dtype=np.int32),
+        feature_names=[f"f{i}" for i in range(N_FEAT)],
+    )
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert resolve_gather_impl("auto", None, panel, W, bf16=True) == "pallas"
+    assert resolve_gather_impl("auto", None, panel, W, bf16=False) == "xla"
+    assert resolve_gather_impl("pallas", None, panel, W,
+                               bf16=False) == "pallas"
+    # Fails closed: a caller that doesn't state the dtype gets XLA.
+    assert win.resolve_gather_impl("auto", None, panel, W) == "xla"
+
+
 def test_vmap_folds_seeds_into_one_kernel():
     """vmap over per-seed index batches (the ensemble) must fold seeds
     into the kernel's date grid axis — ONE pallas_call, no lax.scan

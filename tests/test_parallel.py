@@ -283,6 +283,7 @@ def test_ensemble_shard_map_pallas_matches_xla(lstm_panel, tmp_path):
                                    rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.nightly
 def test_dp_training_lru_matches_single_device(panel, tmp_path):
     """The LRU's associative scan must survive the trainer's shard_map
     (its AD only composes with shard_map under jit — which the trainer

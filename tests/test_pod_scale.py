@@ -14,7 +14,9 @@ import textwrap
 
 import pytest
 
-pytestmark = pytest.mark.slow  # ~1 min: two 64-device subprocesses
+# ~1.5 min of 64-device subprocesses: out of the fast lane (slow) AND the
+# default lane (nightly); full-suite runs keep it.
+pytestmark = [pytest.mark.slow, pytest.mark.nightly]
 
 _POD = textwrap.dedent("""
     import jax

@@ -56,7 +56,7 @@ def _ring(q, k, v, m, mesh):
     return fn(q, k, v, m)
 
 
-@pytest.mark.parametrize("n_dev", [2, 8])
+@pytest.mark.parametrize("n_dev", [2, pytest.param(8, marks=pytest.mark.nightly)])
 def test_ring_matches_full_attention(n_dev):
     mesh = seq_mesh(n_dev)
     q, k, v, m = _qkvm()
@@ -66,6 +66,7 @@ def test_ring_matches_full_attention(n_dev):
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.nightly
 def test_ring_grads_match_full_attention():
     mesh = seq_mesh(8)
     q, k, v, m = _qkvm(seed=1)
@@ -85,6 +86,7 @@ def test_ring_grads_match_full_attention():
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.nightly
 def test_ring_empty_key_rows_zero():
     mesh = seq_mesh(8)
     q, k, v, m = _qkvm(all_invalid_row=True)
@@ -93,6 +95,7 @@ def test_ring_empty_key_rows_zero():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.nightly
 def test_sequence_parallel_transformer_matches_plain():
     """Same params, window sharded 8 ways: identical forecasts."""
     rng = np.random.default_rng(2)
@@ -111,6 +114,7 @@ def test_sequence_parallel_transformer_matches_plain():
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.nightly
 def test_sequence_parallel_transformer_grads():
     """Parameter gradients agree between sharded and plain encoders —
     the training-path guarantee for long-context mode."""
@@ -141,6 +145,7 @@ def test_sequence_parallel_transformer_grads():
             rtol=1e-3, err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.nightly
 @pytest.mark.parametrize("n_dev", [2, 8])
 def test_sequence_parallel_lru_matches_plain(n_dev):
     """The distributed associative scan (models/lru.py) must equal the
@@ -161,6 +166,7 @@ def test_sequence_parallel_lru_matches_plain(n_dev):
                                atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.nightly
 def test_sequence_parallel_lru_grads():
     """Parameter gradients agree between the sharded and plain LRU —
     the training-path guarantee for the long-context linear recurrence."""
@@ -193,6 +199,7 @@ def test_sequence_parallel_lru_grads():
             err_msg=str(path))
 
 
+@pytest.mark.nightly
 def test_seq_parallel_training_from_config(tmp_path):
     """Sequence parallelism as a CONFIG-level training mode: a
     transformer trained with n_seq_shards=4 (window sharded over a
@@ -231,6 +238,7 @@ def test_seq_parallel_training_from_config(tmp_path):
     assert abs(s_seq["best_val_ic"] - s_plain["best_val_ic"]) < 0.05
 
 
+@pytest.mark.nightly
 def test_seq_parallel_lru_training_from_config(tmp_path):
     """Same config-level mode for the LRU: the distributed associative
     scan replaces ring attention; loss trajectory matches plain."""
@@ -315,6 +323,7 @@ def test_seq_parallel_config_validation(tmp_path):
     assert "seq" in dict(etr.mesh.shape)
 
 
+@pytest.mark.nightly
 def test_seq_parallel_resume_and_degrade(tmp_path):
     """Resume re-places restored state on the seq mesh (shard_map needs
     multi-device placement), and an over-wide n_seq_shards degrades to
@@ -361,6 +370,7 @@ def test_seq_parallel_resume_and_degrade(tmp_path):
 
 
 
+@pytest.mark.nightly
 def test_seq_parallel_composes_with_data_parallel(tmp_path):
     """SP × DP on one mesh: n_data_shards=2 × n_seq_shards=4 over the 8
     virtual devices — batches shard dates over 'data', each seq shard
@@ -399,6 +409,7 @@ def test_seq_parallel_composes_with_data_parallel(tmp_path):
     assert abs(s_comp["best_val_ic"] - s_plain["best_val_ic"]) < 0.05
 
 
+@pytest.mark.nightly
 def test_seq_parallel_composes_with_ensemble(tmp_path):
     """The full parallelism matrix: seed × data × seq on one mesh
     (2 seeds × 2 data × 2 seq over the 8 virtual devices). The ensemble's
@@ -445,6 +456,7 @@ def test_seq_parallel_composes_with_ensemble(tmp_path):
                                    rtol=2e-2, atol=2e-4)
 
 
+@pytest.mark.nightly
 def test_seq_fully_degraded_ensemble_still_constructs(tmp_path):
     """When seed×data consume every device, the seq axis degrades to 1
     and the ensemble must construct and train with the plain full-window

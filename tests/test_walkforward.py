@@ -178,6 +178,7 @@ def test_walkforward_nll_stitches_variances_and_total_std(tmp_path):
     assert rc == 0
 
 
+@pytest.mark.nightly
 def test_walkforward_with_sequence_parallelism(panel, tmp_path):
     """Walk-forward retraining composes with n_seq_shards: each fold's
     trainer rebuilds the (data × seq) mesh and the stitched forecasts
