@@ -82,6 +82,12 @@ class OptimConfig:
     epochs: int = 20
     early_stop_patience: int = 5  # epochs without val improvement
     loss: str = "mse"  # mse | huber | rank_ic | nll
+    # adamw | lamb. LAMB (layerwise-adaptive Adam; the large-batch-LSTM
+    # recipe, PAPERS.md "Large-Batch Training for LSTM and Beyond") holds
+    # accuracy when the effective batch grows with the data axis — on a
+    # pod, dates_per_batch × firms_per_date × n_data_shards can reach
+    # 10^5-10^6 firm rows per step, where plain AdamW needs lr re-tuning.
+    optimizer: str = "adamw"
 
 
 @dataclasses.dataclass

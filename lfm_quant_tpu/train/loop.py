@@ -372,10 +372,20 @@ class Trainer:
             0.0, cfg.optim.lr, min(cfg.optim.warmup_steps, total_steps // 2),
             total_steps, end_value=cfg.optim.lr * 0.1,
         )
+        if cfg.optim.optimizer == "adamw":
+            opt = optax.adamw(schedule, weight_decay=cfg.optim.weight_decay)
+        elif cfg.optim.optimizer == "lamb":
+            # Layerwise-adaptive Adam for large effective batches (the
+            # pod-scale data axis): trust-ratio-scaled updates keep the
+            # warmup-cosine schedule usable without per-batch-size lr
+            # re-tuning (PAPERS.md, "Large-Batch Training for LSTM and
+            # Beyond"). Same decoupled weight decay as the adamw path.
+            opt = optax.lamb(schedule, weight_decay=cfg.optim.weight_decay)
+        else:
+            raise ValueError(
+                f"optimizer must be adamw|lamb, got {cfg.optim.optimizer!r}")
         self.tx = optax.chain(
-            optax.clip_by_global_norm(cfg.optim.grad_clip),
-            optax.adamw(schedule, weight_decay=cfg.optim.weight_decay),
-        )
+            optax.clip_by_global_norm(cfg.optim.grad_clip), opt)
 
         if self.mesh is None:
             self._jit_step = jax.jit(self._step_impl)

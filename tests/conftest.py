@@ -11,6 +11,12 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # belt-and-braces for subprocesses
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# bench.main() preempts live campaign/watcher processes to clear the chip
+# for a driver capture (bench._preempt_campaign). Tests exercising main()
+# must NEVER signal a real watcher running on this machine (it happened:
+# a wedged-path test killed the armed recovery watcher). The dedicated
+# preemption test re-enables it against monkeypatched marker patterns.
+os.environ["LFM_BENCH_NO_PREEMPT"] = "1"
 
 import jax  # noqa: E402
 

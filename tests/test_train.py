@@ -179,6 +179,30 @@ def test_bench_scan_impl_override(monkeypatch):
     assert "scan_impl" not in cfg.model.kwargs
 
 
+@pytest.mark.fast
+def test_lamb_optimizer_trains(panel, tmp_path):
+    """optimizer="lamb" (the large-batch recipe, PAPERS.md) plugs into
+    the same loop: loss decreases, signal recovered; unknown optimizers
+    fail loudly at build time."""
+    cfg = tiny_cfg(
+        optim=OptimConfig(lr=3e-3, epochs=4, warmup_steps=10,
+                          early_stop_patience=6, loss="mse",
+                          optimizer="lamb"),
+        out_dir=str(tmp_path),
+    )
+    summary, _, _ = run_experiment(cfg, panel=panel)
+    hist = summary["history"]
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+    assert summary["best_val_ic"] > 0.05
+
+    bad = tiny_cfg(optim=OptimConfig(optimizer="sgd"),
+                   out_dir=str(tmp_path / "bad"))
+    dates = panel.dates
+    splits = PanelSplits.by_date(panel, int(dates[100]), int(dates[120]))
+    with pytest.raises(ValueError, match="optimizer"):
+        Trainer(bad, splits)
+
+
 def test_lru_trains_end_to_end(panel, tmp_path):
     """The time-parallel LRU family plugs into the same train stack and
     learns the planted signal (val IC clears noise)."""
@@ -378,6 +402,58 @@ def test_bench_watchdog_kills_postprobe_hang():
     assert proc.returncode == 1
     rec = _json.loads(proc.stdout.splitlines()[-1])
     assert rec["status"] == "bench_timeout"
+
+
+@pytest.mark.fast
+def test_bench_preempts_running_campaign(monkeypatch, tmp_path):
+    """The driver's end-of-round capture must be able to evict a
+    still-running unattended campaign (the single tunneled chip
+    serializes clients; campaign rows already persisted). Patterns are
+    monkeypatched to a unique marker so the test can never signal a real
+    watcher/campaign on this machine."""
+    import subprocess
+    import sys as _sys
+
+    import bench as bench_mod
+
+    monkeypatch.delenv("LFM_BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.delenv("LFM_BENCH_NO_PREEMPT", raising=False)
+    marker = "scripts/lfm-preempt-test-marker-7f3a.sh"
+    monkeypatch.setattr(bench_mod, "_CAMPAIGN_PATTERNS", (marker,))
+    # A shell root whose CHILD (no marker in its own argv) does the
+    # sleeping — the descendant closure must take both down, like the
+    # campaign's `timeout ... python ...` grandchildren holding the chip.
+    script = tmp_path / marker
+    script.parent.mkdir(parents=True)
+    script.write_text("#!/bin/bash\nsleep 60 &\nwait\n")
+    victim = subprocess.Popen(["bash", str(script)])
+    try:
+        import time as _time
+        for _ in range(200):  # wait for the child sleep to spawn
+            if any(pp == victim.pid
+                   for pp, _a in bench_mod._list_procs().values()):
+                break
+            _time.sleep(0.05)
+        # No-op the TERM→KILL grace sleep only now — bench.time IS the
+        # global time module, so patching earlier would have no-op'd the
+        # spawn wait above too.
+        monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
+        res = bench_mod._preempt_campaign()
+        assert res["killed"] >= 2 and not res["watcher"]  # root + child
+        assert victim.wait(timeout=10) != 0  # TERM/KILLed, not finished
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+    # Anchored matching: an "editor" whose ARGUMENT mentions the script
+    # must never be signalled (argv[0] is not an interpreter/launcher).
+    assert not bench_mod._is_campaign_proc(["vim", marker])
+    assert not bench_mod._is_campaign_proc(["less", f"x/{marker}"])
+    assert bench_mod._is_campaign_proc(["bash", f"/root/repo/{marker}"])
+    assert not bench_mod._is_campaign_proc(
+        ["bash", "-c", f"echo {marker}-suffixed"])  # suffix != path match
+    # The campaign's own bench step (SKIP_PROBE=1) must never self-evict.
+    monkeypatch.setenv("LFM_BENCH_SKIP_PROBE", "1")
+    assert bench_mod._preempt_campaign() == {"killed": 0, "watcher": False}
 
 
 @pytest.mark.fast
