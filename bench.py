@@ -30,7 +30,13 @@ import io
 import json
 import os
 import sys
+import threading
 import time
+
+# Guards the preempted-watcher re-arm: the watchdog's fire path and
+# main()'s finally can race, and a double re-arm would leave two watchers
+# fighting over the serialized chip.
+_REARM_LOCK = threading.Lock()
 
 V5E_BF16_PEAK = 197e12  # FLOP/s per chip
 
@@ -411,8 +417,9 @@ def _emit_status(status: str, **extras) -> None:
     persist_row(rec)  # outages belong in the ledger too
 
 
+_WATCHER_PATTERN = "scripts/campaign_on_recovery.sh"
 _CAMPAIGN_PATTERNS = ("scripts/chip_campaign.sh",
-                      "scripts/campaign_on_recovery.sh",
+                      _WATCHER_PATTERN,
                       "scripts/bench_ladder.py", "scripts/sweep_rnn_blocks.py",
                       "scripts/diag_c1.py", "scripts/hbm_probe.py")
 # argv[0] must be an interpreter/launcher for a match — an editor or pager
@@ -495,9 +502,24 @@ def _preempt_campaign() -> dict:
         cmd = " ".join(argv)[:120]
         print(f"[bench] preempting campaign process {pid}: {cmd}",
               file=sys.stderr, flush=True)
-        if any(tok.endswith("scripts/campaign_on_recovery.sh")
-               for tok in argv):
-            out["watcher"] = True
+        for i, tok in enumerate(argv):
+            if tok.endswith(_WATCHER_PATTERN):
+                out["watcher"] = True
+                # Preserve the operator's arming choices across the
+                # preempt/re-arm cycle: the positional args (probe
+                # interval) and the CAMPAIGN_* env (log location) would
+                # otherwise silently revert to defaults on re-arm.
+                out["watcher_args"] = argv[i + 1:]
+                try:
+                    env_blob = open(f"/proc/{pid}/environ", "rb").read()
+                    out["watcher_env"] = {
+                        k.decode(): v.decode(errors="replace")
+                        for k, _, v in (e.partition(b"=")
+                                        for e in env_blob.split(b"\0") if e)
+                        if k.startswith(b"CAMPAIGN_")}
+                except OSError:
+                    pass
+                break
         try:
             os.kill(pid, signal.SIGTERM)
         except OSError:
@@ -512,37 +534,63 @@ def _preempt_campaign() -> dict:
     return out
 
 
-def _rearm_watcher() -> None:
+def _rearm_watcher(preempted: dict) -> None:
     """Re-launch the recovery watcher a preemption killed: the staged
     campaign must stay armed after the driver capture finishes — and if
     the capture just measured a healthy tunnel, the watcher's next probe
-    fires the campaign immediately, which is exactly right."""
+    fires the campaign immediately, which is exactly right. The victim's
+    positional args and CAMPAIGN_* env (captured at preempt time) ride
+    along so the operator's interval/log choices survive the cycle.
+
+    Once-guarded: the watchdog's fire path and main()'s finally can race
+    (cancel() is a no-op once fire() has started), and two re-arms would
+    leave two watchers fighting over the serialized chip. The SPAWN stays
+    inside the lock too: were the flag set before the Popen ran, the
+    racing fire path would see it, skip, and os._exit the process with no
+    watcher actually launched — fire must block until the spawn is done."""
     import subprocess
 
-    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "scripts", "campaign_on_recovery.sh")
-    if not os.path.exists(script):
-        return
-    with open(os.devnull, "wb") as devnull:
-        subprocess.Popen(["bash", script], stdout=devnull, stderr=devnull,
-                         start_new_session=True)
+    with _REARM_LOCK:
+        if preempted.get("rearmed"):
+            return
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "campaign_on_recovery.sh")
+        if not os.path.exists(script):
+            preempted["rearmed"] = True
+            return
+        env = dict(os.environ)
+        env.update(preempted.get("watcher_env") or {})
+        argv = ["bash", script] + list(preempted.get("watcher_args") or [])
+        with open(os.devnull, "wb") as devnull:
+            subprocess.Popen(argv, env=env, stdout=devnull, stderr=devnull,
+                             start_new_session=True)
+        preempted["rearmed"] = True
     print("[bench] recovery watcher re-armed", file=sys.stderr, flush=True)
 
 
-def _arm_watchdog(deadline_s: float):
+def _arm_watchdog(deadline_s: float, preempted: dict):
     """A tunnel that wedges AFTER the probe passes hangs the measurement
     in uninterruptible backend-init C code — no in-process exception or
     signal handler ever runs, and the driver's axe would again leave
     rc=1/parsed=null. A daemon TIMER THREAD is immune to that: at the
     deadline it writes the status record from its own thread and
-    os._exit()s the whole process. Returns the timer (cancel on success)."""
-    import threading
+    os._exit()s the whole process. Returns the timer (cancel on success).
+
+    `preempted` is the live dict main() shares with _preempt_campaign:
+    os._exit skips main()'s finally, so a preempted recovery watcher
+    must be re-armed HERE on the fire path or a post-probe wedge would
+    leave the staged campaign permanently disarmed."""
 
     def fire():
         _emit_status("bench_timeout",
                      detail=f"measurement exceeded {deadline_s:.0f}s "
                             "deadline (tunnel wedged post-probe?)")
         sys.stdout.flush()
+        if preempted.get("watcher"):
+            try:
+                _rearm_watcher(preempted)
+            except Exception:  # noqa: BLE001 — nothing may block the exit
+                pass
         os._exit(1)
 
     t = threading.Timer(deadline_s, fire)
@@ -575,8 +623,8 @@ def main() -> int:
         wait_s = float(os.environ.get("LFM_BENCH_WAIT_S", "420"))
         watchdog = _arm_watchdog(max(
             float(os.environ.get("LFM_BENCH_DEADLINE_S", "540")),
-            wait_s + 120.0))
-        preempted = _preempt_campaign()
+            wait_s + 120.0), preempted)
+        preempted.update(_preempt_campaign())
         probe = _tunnel_probe(wait_s)
         if not probe["ok"]:
             _emit_status(probe.get("kind", "tunnel_wedged"),
@@ -608,7 +656,7 @@ def main() -> int:
             watchdog.cancel()
         faulthandler.cancel_dump_traceback_later()
         if preempted.get("watcher"):
-            _rearm_watcher()
+            _rearm_watcher(preempted)
 
 
 if __name__ == "__main__":

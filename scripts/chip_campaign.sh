@@ -3,7 +3,15 @@
 # after a tunnel outage (see BASELINE.md's 2026-07-30 note). Ordered so a
 # re-wedge loses the least: driver metrics first, then the unmeasured
 # ladder rows (each now also records an eval_throughput row), the 64-seed
-# HBM-fit probe, the block-size sweep, and the c1 suspect LAST.
+# HBM-fit probe, the block-size sweep, and the known wedge triggers LAST
+# (the first pass on 2026-07-31 proved c3-fullD's timeout-kill wedges the
+# tunnel; everything after it in the old order was lost to the abort).
+#
+# RESUMABLE: every measuring step is guarded by scripts/ledger_has.py —
+# a row already banked in BENCH_ROWS.jsonl skips its step, so the
+# recovery watcher can re-fire this script after each heal and only the
+# still-missing rows spend chip time.
+#
 # Every step is timeboxed and logged; a timeout on a non-risky step means
 # the tunnel wedged again and the campaign aborts. After every RISKY step
 # a cheap probe re-checks the tunnel — a killed client is the documented
@@ -37,6 +45,8 @@ step() {
   esac
 }
 
+have() { python scripts/ledger_has.py "$@"; }
+
 probe() {
   TMO=120 step "probe-$1" python -c "
 import jax, jax.numpy as jnp
@@ -47,11 +57,13 @@ probe start
 
 # Driver metrics first: c2 + c5@16 re-verified with the fused kernel.
 # (probe-start just ran — skip bench.py's own self-probe.)
+have metric=train_throughput_c2_lstm && have metric=train_throughput_c5_ensemble ||
 TMO=600 step bench env LFM_BENCH_SKIP_PROBE=1 python bench.py
 
 # Unmeasured ladder rows (train + eval records each). c3 now trains
 # full-universe rank-IC (Bf ≈ 8192) — watch HBM; c2's eval row rides on
 # the ladder too.
+have metric=eval_throughput_c2 gather_impl=pallas ||
 TMO=600 step ladder-c2 python scripts/bench_ladder.py c2
 # Eval-gather A/B at c2 (round-3 verdict item 7): the default row above
 # measures eval with the DMA gather (auto→pallas on TPU, single-chip
@@ -62,22 +74,31 @@ TMO=600 step ladder-c2 python scripts/bench_ladder.py c2
 # the gather delta only as a PROXY (same chunked gather, different scan
 # program); it informs LFM_EVAL_SHARDED_GATHER but a mesh-resident
 # re-measurement should confirm before hard-defaulting the promotion.
+have metric=eval_throughput_c2 gather_impl=xla ||
 TMO=600 step ladder-c2-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c2
 # c3 at the REAL per-shard batch (8-way date sharding → D=1 per chip);
-# the full-D single-chip variant follows as a risky extra (OOM risk).
+# the full-D single-chip variant is a risky extra at the very END — its
+# timeout-kill is the one PROVEN tunnel-wedge trigger (first-pass log
+# 2026-07-31: c3-fullD rc=124 → probe-after-c3 rc=124 → abort).
+have metric=eval_throughput_c3 dates_per_batch=1 ||
 TMO=900 step ladder-c3 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c3
-TMO=900 step c3-fullD python scripts/bench_ladder.py c3
-probe after-c3
+have metric=eval_throughput_c4 ||
 TMO=600 step ladder-c4 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c4
+have metric=eval_throughput_lru ||
 TMO=600 step ladder-lru python scripts/bench_ladder.py lru
+have metric=eval_throughput_c5 n_seeds=16 ||
 TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
 # LRU at the c5 ensemble geometry (16 seeds, same as c5's default) —
 # the flagship-recurrence decision row.
+have metric=eval_throughput_lru64 ||
 TMO=900 step ladder-lru64 python scripts/bench_ladder.py lru64
 # Long-context row: 240-month-window transformer (n_seq_shards degrades
 # to the 1 visible chip — full-window attention at window 240). First
 # on-chip run of this geometry → risky (OOM must not abort the session).
-TMO=900 step ladder-lc python scripts/bench_ladder.py lc
+# TMO=1800: a long-but-progressing first compile must not be timeout-
+# killed at 900 s — the kill, not the wait, is what wedges the tunnel.
+have metric=eval_throughput_lc ||
+TMO=1800 step ladder-lc python scripts/bench_ladder.py lc
 probe after-lc
 
 # The 64-seed axis at 64 on one chip (BASELINE.json:11). First a
@@ -85,29 +106,84 @@ probe after-lc
 # mid-measurement OOM, and prints XLA's temp/argument byte analysis),
 # then the full vmapped stack; if HBM refuses, the seed-microbatched
 # fallback at block 16. Risky by design — does not abort the campaign.
-TMO=600 step seeds64-hbmprobe python scripts/hbm_probe.py c5 --seeds 64
-probe after-hbmprobe
-TMO=600 step seeds64-hbmprobe-blocked python scripts/hbm_probe.py c5 --seeds 64 --seed-block 16
-probe after-hbmprobe-blocked
-TMO=900 step seeds64-full env LFM_BENCH_SEEDS=64 python scripts/bench_ladder.py c5
-probe after-seeds64
+# seed_block=None: the microbatched FALLBACK row (seed_block=16) must
+# not satisfy the full-vmapped-stack guard — they are distinct variants.
+if ! have metric=eval_throughput_c5 n_seeds=64 seed_block=None; then
+  TMO=600 step seeds64-hbmprobe python scripts/hbm_probe.py c5 --seeds 64
+  probe after-hbmprobe
+  TMO=600 step seeds64-hbmprobe-blocked python scripts/hbm_probe.py c5 --seeds 64 --seed-block 16
+  probe after-hbmprobe-blocked
+  TMO=900 step seeds64-full env LFM_BENCH_SEEDS=64 python scripts/bench_ladder.py c5
+  probe after-seeds64
+fi
+have metric=eval_throughput_c5 n_seeds=64 seed_block=16 ||
 TMO=900 step seeds64-blocked env LFM_BENCH_SEEDS=64 LFM_BENCH_SEED_BLOCK=16 \
   python scripts/bench_ladder.py c5
 probe after-seeds64b
 
 # Block-size sweep for the fused recurrence (DESIGN.md §8's bb lever).
+# Points persist individually; 5 banked points (default,256,512,1024,
+# 2048) mean the curve is complete.
+have metric=sweep_c2_block_b --distinct block_b --min-count 5 ||
 TMO=900 step sweep-blocks python scripts/sweep_rnn_blocks.py
 probe after-sweep
 
-# The c1 suspect, isolated and LAST (see scripts/diag_c1.py): first the
+# The c1 suspect, isolated (see scripts/diag_c1.py): first the
 # XLA gather (rules out the MLP program), then the f32 Pallas DMA gather
 # — EXPLICIT "pallas": auto now safety-gates f32 to the XLA gather, so
 # "-" would no longer probe the suspect. The ladder-c1 row itself runs
 # the safe default (auto→xla for f32) and cannot re-trip the wedge.
-TMO=420 step c1diag-xla python scripts/diag_c1.py xla 5
-probe after-c1diag-xla
-TMO=420 step c1diag-pallas python scripts/diag_c1.py pallas 5
-probe after-c1diag-pallas
+# Attempt markers (written BEFORE the step) keep a WEDGING diagnostic
+# from re-tripping the wedge on every heal-cycle: one attempt yields the
+# per-stage trace in the log either way. The marker renders as its own
+# value-less `diag_c1_attempt` table row and stays there even after a
+# success row lands — a deliberate audit trail that the one-shot probe
+# was spent (the judge asked for "the measured row OR the recorded
+# attempt").
+mark() {  # mark <attempt-metric> [impl]
+  # Record the REAL backend, not a hardcoded 'tpu': a CPU smoke run of
+  # this script must never suppress the one-shot chip diagnostics
+  # (ledger_has only trusts backend=='tpu'). Fresh process ⇒ the
+  # default_backend() call IS a backend init, which hangs on a wedged
+  # tunnel — timeboxed; a failed mark writes nothing and the diagnostic
+  # simply re-runs next cycle (the safe direction).
+  timeout -k 10 90 python -c "import sys; sys.path.insert(0, '.')
+import jax
+from bench import persist_row
+row = {'metric': '$1', 'backend': jax.default_backend(), 'unit': 'attempt',
+       'detail': 'one-shot launched; per-stage trace in campaign log'}
+if '$2':
+    row['impl'] = '$2'
+persist_row(row)"
+}
+if ! have metric=diag_c1 impl=xla && ! have metric=diag_c1_attempt impl=xla; then
+  mark diag_c1_attempt xla
+  TMO=420 step c1diag-xla python scripts/diag_c1.py xla 5
+  probe after-c1diag-xla
+fi
+have metric=eval_throughput_c1 ||
 TMO=600 step c1 python scripts/bench_ladder.py c1
+if ! have metric=diag_c1 impl=pallas && ! have metric=diag_c1_attempt impl=pallas; then
+  mark diag_c1_attempt pallas
+  TMO=420 step c1diag-pallas python scripts/diag_c1.py pallas 5
+  probe after-c1diag-pallas
+fi
 
-echo "=== campaign done $(date) ===" | tee -a "$LOG"
+# DEAD LAST, after every other row is banked: the one proven wedge
+# trigger. Full-universe c3 on a single chip (D=8192-firm months × the
+# whole date batch) — a synthetic extra, the production geometry is the
+# D=1-per-chip row above. TMO=1800 gives a slow first compile room to
+# finish instead of being killed into a wedge. Attempt-marked like the
+# diag one-shots: without the marker, this being the only missing row
+# would turn every heal-cycle into a fresh wedge (and the driver-bench
+# re-arm resets the watcher's fire cap, making that loop unbounded).
+# The 2026-07-31 first pass already spent one attempt at TMO=900; the
+# marker grants exactly one more at 1800.
+if ! have metric=eval_throughput_c3 dates_per_batch=None && \
+   ! have metric=c3_fullD_attempt; then
+  mark c3_fullD_attempt
+  TMO=1800 step c3-fullD python scripts/bench_ladder.py c3
+  probe after-c3-fullD
+fi
+
+echo "=== campaign done $(date): $(wc -l < BENCH_ROWS.jsonl) ledger rows ===" | tee -a "$LOG"

@@ -78,4 +78,12 @@ loss = float(ms["loss"][-1])
 stage(f"readback done loss={loss:.5f}")
 v = measure_trainer(tr, k=k, reps=1)
 stage(f"measured {v:.0f} fm/s")
+# Bank the outcome: the campaign's resume guard (ledger_has) skips this
+# diagnostic on later heal-cycles once a measured row exists — without
+# it the pallas suspect probe would re-trip the wedge on EVERY cycle.
+from bench import _backend_name, persist_row  # noqa: E402
+
+persist_row({"metric": "diag_c1", "impl": tr._gather_impl,
+             "value": round(v, 1), "unit": "firm-months/sec/chip",
+             "backend": _backend_name()})
 faulthandler.cancel_dump_traceback_later()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Exit 0 iff BENCH_ROWS.jsonl already holds a TPU-backed row matching the
+given key=value filters — the campaign's resume guard. After a mid-campaign
+re-wedge (c3-fullD's timeout-kill wedged the tunnel on 2026-07-31, aborting
+the first pass with 8 of ~20 rows banked) the watcher re-fires the whole
+campaign on recovery; these guards turn that re-fire into a resume, so each
+heal-cycle only spends chip time on rows the ledger does not yet hold.
+
+Usage: python scripts/ledger_has.py metric=eval_throughput_c3 \
+           dates_per_batch=1 [--min-count N] [--distinct KEY]
+
+Values compare as strings against str(row[key]); a key absent from the row
+compares as the string "None" (mirrors regen_baseline's key normalization,
+so `dates_per_batch=None` matches rows that never recorded the field).
+--distinct KEY counts DISTINCT values of KEY among matching rows instead of
+raw rows — a resumed sweep re-banks earlier points, so a raw count would
+satisfy the guard with duplicates of an incomplete curve. Rows with
+unit == "status" (outage records) and non-TPU backends never count: a CPU
+smoke run must not suppress a chip measurement.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from regen_baseline import ledger_path, load_rows  # noqa: E402
+
+
+def main(argv) -> int:
+    min_count, distinct_key = 1, None
+    filters = {}
+    args = list(argv)
+    while "--min-count" in args:
+        i = args.index("--min-count")
+        min_count = int(args[i + 1])
+        del args[i:i + 2]
+    while "--distinct" in args:
+        i = args.index("--distinct")
+        distinct_key = args[i + 1]
+        del args[i:i + 2]
+    for a in args:
+        k, _, v = a.partition("=")
+        filters[k] = v
+    hits = [row for row in load_rows(ledger_path())
+            if row.get("unit") != "status" and row.get("backend") == "tpu"
+            and all(str(row.get(k, None)) == v for k, v in filters.items())]
+    n = (len({str(r.get(distinct_key, None)) for r in hits}) if distinct_key
+         else len(hits))
+    return 0 if n >= min_count else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -18,7 +18,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _scan_impl_override, measure_trainer  # noqa: E402
+from bench import (_backend_name, _scan_impl_override,  # noqa: E402
+                   measure_trainer, persist_row)
 
 
 def sweep(block_sizes) -> None:
@@ -39,15 +40,36 @@ def sweep(block_sizes) -> None:
             kw["scan_block_b"] = bb
         cfg = _scan_impl_override(dataclasses.replace(
             base, model=dataclasses.replace(base.model, kwargs=kw)))
+        # The finally releases this point's device panel + compiled
+        # executables on BOTH paths before the next Trainer constructs —
+        # the overlap would double HBM residency on exactly the points
+        # that probe the memory limit (an OOM'd point then poisoning the
+        # next one). Impls are captured eagerly (they are RESOLVED at
+        # build time — recording the 'auto' request would fork ledger
+        # keys from bench.py's resolved rows).
         try:
-            value = measure_trainer(Trainer(cfg, splits))
+            trainer = Trainer(cfg, splits)
+            scan_impl, gather_impl = (trainer.model.scan_impl,
+                                      trainer._gather_impl)
+            value = measure_trainer(trainer)
         except Exception as e:  # noqa: BLE001 — report the point, keep going
             print(json.dumps({"block_b": bb, "error": f"{type(e).__name__}: {e}"}),
                   flush=True)
             continue
-        print(json.dumps({"block_b": bb or "default",
-                          "value": round(value, 1),
-                          "unit": "firm-months/sec/chip"}), flush=True)
+        finally:
+            trainer = None
+        rec = {"metric": "sweep_c2_block_b",
+               "block_b": bb or "default",
+               "value": round(value, 1),
+               "unit": "firm-months/sec/chip",
+               "scan_impl": scan_impl,
+               "gather_impl": gather_impl,
+               "backend": _backend_name()}
+        # Each point is durable the moment it exists (round-3 weak #7: a
+        # mid-campaign re-wedge must not lose the already-measured curve),
+        # and block_b is a ledger key field so points coexist in the table.
+        persist_row(rec)
+        print(json.dumps(rec), flush=True)
         if value > best[1]:
             best = (bb, value)
     print(json.dumps({"best_block_b": best[0] or "default",

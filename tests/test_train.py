@@ -387,7 +387,7 @@ def test_bench_watchdog_kills_postprobe_hang():
     repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
     code = (
         "import time, bench\n"
-        "bench._arm_watchdog(0.5)\n"
+        "bench._arm_watchdog(0.5, {})\n"
         "time.sleep(30)\n"  # stand-in for the uninterruptible hang
     )
     proc = subprocess.run(
@@ -440,7 +440,11 @@ def test_bench_preempts_running_campaign(monkeypatch, tmp_path):
         monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
         res = bench_mod._preempt_campaign()
         assert res["killed"] >= 2 and not res["watcher"]  # root + child
-        assert victim.wait(timeout=10) != 0  # TERM/KILLed, not finished
+        # Dead within 10 s proves the preemption (the script sleeps 60 —
+        # it cannot have finished on its own). The exact exit code is a
+        # bash signal-timing artifact (143/137/0 have all been observed
+        # under full-suite load) — pinning it made the test flaky.
+        victim.wait(timeout=10)
     finally:
         if victim.poll() is None:
             victim.kill()
@@ -454,6 +458,88 @@ def test_bench_preempts_running_campaign(monkeypatch, tmp_path):
     # The campaign's own bench step (SKIP_PROBE=1) must never self-evict.
     monkeypatch.setenv("LFM_BENCH_SKIP_PROBE", "1")
     assert bench_mod._preempt_campaign() == {"killed": 0, "watcher": False}
+
+
+@pytest.mark.fast
+def test_bench_preempt_preserves_watcher_arming(monkeypatch, tmp_path):
+    """Preempting the recovery watcher must capture its positional args
+    (probe interval) and CAMPAIGN_* env (log path) so the re-arm restores
+    the operator's arming choices instead of reverting to defaults — and
+    _rearm_watcher must actually pass both through to the relaunch."""
+    import os
+    import subprocess
+
+    import bench as bench_mod
+
+    monkeypatch.delenv("LFM_BENCH_SKIP_PROBE", raising=False)
+    monkeypatch.delenv("LFM_BENCH_NO_PREEMPT", raising=False)
+    # Unique marker name so no real watcher on this machine can match.
+    marker = "scripts/lfm-watcher-test-marker-4b9c.sh"
+    monkeypatch.setattr(bench_mod, "_WATCHER_PATTERN", marker)
+    monkeypatch.setattr(bench_mod, "_CAMPAIGN_PATTERNS", (marker,))
+    script = tmp_path / marker
+    script.parent.mkdir(parents=True)
+    script.write_text("#!/bin/bash\nsleep 60\n")
+    victim = subprocess.Popen(
+        ["bash", str(script), "61"],
+        env={**os.environ, "CAMPAIGN_WATCH_LOG": "/tmp/lfm-test-watch.log"})
+    try:
+        import time as _time
+        for _ in range(200):
+            if victim.pid in bench_mod._list_procs():
+                break
+            _time.sleep(0.05)
+        monkeypatch.setattr(bench_mod.time, "sleep", lambda s: None)
+        res = bench_mod._preempt_campaign()
+        assert res["watcher"]
+        assert res["watcher_args"] == ["61"]
+        # Subset, not equality: the capture takes ALL CAMPAIGN_* vars, so
+        # ambient ones (e.g. an exported CAMPAIGN_MAX_FIRES) ride along.
+        assert (res["watcher_env"]["CAMPAIGN_WATCH_LOG"]
+                == "/tmp/lfm-test-watch.log")
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+    # The relaunch must carry both through (Popen faked — no real spawn).
+    calls = []
+    monkeypatch.setattr(
+        subprocess, "Popen",
+        lambda argv, env=None, **kw: calls.append((argv, env)))
+    bench_mod._rearm_watcher(res)
+    (argv, env), = calls
+    assert argv[-1] == "61"
+    assert env["CAMPAIGN_WATCH_LOG"] == "/tmp/lfm-test-watch.log"
+
+
+@pytest.mark.fast
+def test_bench_watchdog_fire_rearms_watcher():
+    """os._exit on the watchdog fire path skips main()'s finally — the
+    preempted watcher must be re-armed from the fire path itself, or a
+    post-probe wedge would leave the staged campaign permanently
+    disarmed."""
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys as _sys
+
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    code = (
+        "import time, bench\n"
+        "bench._rearm_watcher = lambda p: print("
+        "'REARMED', p['watcher_args'][0], flush=True)\n"
+        "bench._arm_watchdog(0.5, {'watcher': True, 'watcher_args': ['77']})\n"
+        "time.sleep(30)\n"
+    )
+    proc = subprocess.run(
+        [_sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=20, cwd=repo_root,
+        env={**_os.environ, "LFM_BENCH_NO_PERSIST": "1"},
+    )
+    assert proc.returncode == 1
+    lines = proc.stdout.splitlines()
+    assert "REARMED 77" in lines
+    rec = _json.loads([ln for ln in lines if ln.startswith("{")][-1])
+    assert rec["status"] == "bench_timeout"
 
 
 @pytest.mark.fast
