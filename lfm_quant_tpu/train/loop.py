@@ -344,15 +344,26 @@ class Trainer:
             # spans are validated for the full window only, so the train
             # gather takes the XLA path under a seq axis.
             self._gather_impl = "xla"
+        # Eval defaults to the XLA gather even where the DMA gather is
+        # legal: the on-chip A/B (BENCH_ROWS.jsonl, 2026-07-31, c2) put
+        # the XLA-gather eval at 48.0M fm/s vs 33.4M for the DMA gather
+        # (+44% — the full-cross-section sweep is gather-bound in a way
+        # the train step is not), and the XLA rows were measured LATER
+        # in the session, so tunnel-state drift biases against them.
+        # An EXPLICIT gather_impl="pallas" config still carries into
+        # single-chip eval (the A/B override path); "auto" never does.
         self._eval_gather_impl = (
-            self._gather_impl if self.mesh is None else "xla")
-        # Sharded-eval gather promotion, flag-gated until measured on
-        # chip: inside the month-sharded shard_map each shard is locally
-        # un-partitioned, so the DMA gather is as legal there as in the
-        # train step. LFM_EVAL_SHARDED_GATHER=pallas opts the sharded
-        # dispatches (axis != None in _forward_impl) into it when the
-        # panel is already lane-padded for the train gather; the GSPMD
-        # paths (MC-dropout sampling, no-mesh eval) are untouched.
+            self._gather_impl
+            if d.gather_impl == "pallas" and self.mesh is None else "xla")
+        # Sharded-eval gather promotion, flag-gated: inside the
+        # month-sharded shard_map each shard is locally un-partitioned,
+        # so the DMA gather is as legal there as in the train step.
+        # LFM_EVAL_SHARDED_GATHER=pallas opts the sharded dispatches
+        # (axis != None in _forward_impl) into it when the panel is
+        # already lane-padded for the train gather; the GSPMD paths
+        # (MC-dropout sampling, no-mesh eval) are untouched. The c2 A/B
+        # above makes this promotion unlikely to pay — kept for the
+        # mesh-resident re-measurement.
         self._eval_gather_sharded = self._eval_gather_impl
         if (os.environ.get("LFM_EVAL_SHARDED_GATHER") == "pallas"
                 and self._gather_impl == "pallas"):

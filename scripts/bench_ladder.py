@@ -201,11 +201,17 @@ def bench_config(name: str):
     _log(f"{name}: done")
     # The EVAL dispatch's own gather (promotion flag included) — not the
     # train gather: the A/B rows the promotion flag exists for must get
-    # distinct regen keys.
+    # distinct regen keys. lane_pad records the PANEL LAYOUT the eval
+    # gathered from: since auto-config eval always rides the XLA gather,
+    # a train-gather A/B pair's eval rows share gather_impl=xla but
+    # measure different layouts (the pallas-train leg lane-pads the
+    # device panel) — without the tag, regen's latest-per-key rule would
+    # silently overwrite one with the other.
     eval_extras = dict(extras)
     eval_extras["gather_impl"] = (
         inner._eval_gather_sharded if eval_path(trainer) == "month_sharded"
         else inner._eval_gather_impl)
+    eval_extras["lane_pad"] = inner._gather_impl == "pallas"
     yield {
         "metric": f"eval_throughput_{name}",
         "value": round(eval_value, 1),

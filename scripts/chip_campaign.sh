@@ -62,19 +62,15 @@ TMO=600 step bench env LFM_BENCH_SKIP_PROBE=1 python bench.py
 
 # Unmeasured ladder rows (train + eval records each). c3 now trains
 # full-universe rank-IC (Bf ≈ 8192) — watch HBM; c2's eval row rides on
-# the ladder too.
-have metric=eval_throughput_c2 gather_impl=pallas ||
+# the ladder too. This c2 pair is now a TRAIN-gather A/B: since the
+# 2026-07-31 eval A/B (pallas 33.4M vs xla 48.0M) flipped the eval
+# default, auto-config eval ALWAYS rides the XLA gather, so both legs'
+# eval rows measure the same program (they differ only in panel layout,
+# tagged lane_pad) and the guards key on the train rows — the only
+# artifact that distinguishes the legs.
+have metric=train_throughput_c2 gather_impl=pallas ||
 TMO=600 step ladder-c2 python scripts/bench_ladder.py c2
-# Eval-gather A/B at c2 (round-3 verdict item 7): the default row above
-# measures eval with the DMA gather (auto→pallas on TPU, single-chip
-# eval is unsharded so _eval_gather_impl == _gather_impl); this row is
-# the XLA-gather twin. Caveat for the multi-chip read-across: the
-# month-sharded eval runs the force_xla_scan twin MODEL, while this
-# single-chip pair runs the Pallas-scan model — so the pair measures
-# the gather delta only as a PROXY (same chunked gather, different scan
-# program); it informs LFM_EVAL_SHARDED_GATHER but a mesh-resident
-# re-measurement should confirm before hard-defaulting the promotion.
-have metric=eval_throughput_c2 gather_impl=xla ||
+have metric=train_throughput_c2 gather_impl=xla ||
 TMO=600 step ladder-c2-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c2
 # c3 at the REAL per-shard batch (8-way date sharding → D=1 per chip);
 # the full-D single-chip variant is a risky extra at the very END — its
@@ -88,6 +84,14 @@ have metric=eval_throughput_lru ||
 TMO=600 step ladder-lru python scripts/bench_ladder.py lru
 have metric=eval_throughput_c5 n_seeds=16 ||
 TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
+# Train-gather A/B at the FLAGSHIP geometry: the c2 A/B favored the XLA
+# gather for train too (+6%), but the auto default only flips once the
+# ensemble geometry (per-seed gathers) confirms it. Guard keys on the
+# train row; the pair's eval rows both ride the XLA gather but coexist
+# in the ledger under distinct lane_pad tags (padded panel for the
+# pallas-train leg, un-padded for the xla leg).
+have metric=train_throughput_c5 n_seeds=16 gather_impl=xla ||
+TMO=900 step ladder-c5-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c5
 # LRU at the c5 ensemble geometry (16 seeds, same as c5's default) —
 # the flagship-recurrence decision row.
 have metric=eval_throughput_lru64 ||

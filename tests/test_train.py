@@ -405,6 +405,40 @@ def test_bench_watchdog_kills_postprobe_hang():
 
 
 @pytest.mark.fast
+def test_eval_gather_defaults_to_xla(panel, tmp_path, monkeypatch):
+    """The eval sweep must ride the XLA gather even when the TRAIN gather
+    auto-resolves to the Pallas DMA gather: the on-chip A/B (2026-07-31,
+    BENCH_ROWS.jsonl) measured the XLA-gather eval 44% faster — the
+    full-cross-section sweep is gather-bound in a way the train step is
+    not. An EXPLICIT gather_impl='pallas' config still carries into
+    single-chip eval (the A/B override path)."""
+    import dataclasses
+
+    import lfm_quant_tpu.train.loop as loop_mod
+
+    # Simulate the TPU resolution on CPU: auto → pallas for the train
+    # gather (attribute wiring only — nothing is dispatched).
+    monkeypatch.setattr(loop_mod, "resolve_gather_impl",
+                        lambda *a, **k: "pallas")
+    t_auto = Trainer(tiny_cfg(out_dir=str(tmp_path / "a")),
+                     PanelSplits.by_date(panel, 198001, 198201))
+    assert t_auto._gather_impl == "pallas"
+    assert t_auto._eval_gather_impl == "xla"
+    # The eval sweep must actually DISPATCH through the XLA gather — on
+    # CPU the Pallas path cannot run, so a finite IC proves the eval
+    # program never touched the (pallas-wired) train gather.
+    m = t_auto.evaluate(t_auto.init_state().params)
+    assert np.isfinite(m["ic"])
+
+    cfg_exp = tiny_cfg(out_dir=str(tmp_path / "b"))
+    cfg_exp = dataclasses.replace(
+        cfg_exp, data=dataclasses.replace(cfg_exp.data,
+                                          gather_impl="pallas"))
+    t_exp = Trainer(cfg_exp, PanelSplits.by_date(panel, 198001, 198201))
+    assert t_exp._eval_gather_impl == "pallas"
+
+
+@pytest.mark.fast
 def test_bench_preempts_running_campaign(monkeypatch, tmp_path):
     """The driver's end-of-round capture must be able to evict a
     still-running unattended campaign (the single tunneled chip
