@@ -22,6 +22,17 @@ from bench import (_backend_name, _scan_impl_override,  # noqa: E402
                    measure_trainer, persist_row)
 
 
+def _banked_rows():
+    """TPU sweep rows already in the ledger — a resumed sweep (the
+    campaign re-fires after each tunnel heal) must spend chip time only
+    on the points a prior pass did not bank."""
+    from regen_baseline import ledger_path, load_rows
+
+    return [r for r in load_rows(ledger_path())
+            if r.get("metric") == "sweep_c2_block_b"
+            and r.get("backend") == "tpu"]
+
+
 def sweep(block_sizes) -> None:
     from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.data import PanelSplits, synthetic_panel
@@ -33,8 +44,33 @@ def sweep(block_sizes) -> None:
                             n_features=d.n_features, horizon=d.horizon,
                             seed=0)
     splits = PanelSplits.by_date(panel, 198601, 198801)
+    # Pre-build skip matches on the impl THIS run would sweep: the env
+    # override when set (resolved == requested then), else the auto
+    # resolution for this backend (config.py: pallas_fused on TPU, xla
+    # elsewhere) — a curve banked under a different variant must not
+    # suppress the default variant's points, and a point must not cost a
+    # Trainer build just to discover it was already measured.
+    import jax
+
+    want = (os.environ.get("LFM_BENCH_SCAN_IMPL")
+            or base.model.kwargs.get("scan_impl")
+            or ("pallas_fused" if jax.default_backend() == "tpu" else "xla"))
+    banked = {r.get("block_b"): float(r.get("value", 0.0))
+              for r in _banked_rows() if r.get("scan_impl") == want}
+    # Banked points compete in the best-point summary too — a resumed
+    # sweep measuring only the residual points must not crown a "best"
+    # that the already-banked curve beats (or report 0.0 on a fully
+    # banked resume).
     best = (None, 0.0)
+    for b, v in banked.items():
+        if v > best[1]:
+            best = (None if b == "default" else b, v)
     for bb in block_sizes:
+        key_bb = bb or "default"
+        if key_bb in banked:
+            print(json.dumps({"block_b": key_bb, "skipped": "already banked",
+                              "value": banked[key_bb]}), flush=True)
+            continue
         kw = dict(base.model.kwargs)
         if bb:
             kw["scan_block_b"] = bb
