@@ -99,52 +99,22 @@ def main(argv=None) -> int:
                      "file holds a single model's (already-aggregated) "
                      "walk-forward forecasts")
     else:
-        is_ensemble = os.path.exists(
-            os.path.join(args.run_dir, "ensemble.flag"))
-        if is_ensemble and args.mc_samples > 0:
-            ap.error("--mc-samples applies to single-model run dirs only; "
-                     "this is a seed ensemble — its uncertainty comes from "
-                     "the seeds (use --mode mean_minus_std directly)")
-        split = args.split or "test"
-        if is_ensemble:
-            from lfm_quant_tpu.train.ensemble import load_ensemble
-            ens, splits = load_ensemble(args.run_dir)
-            if args.mode == "mean_minus_total_std":
-                stacked, avar, stacked_valid = ens.predict(
-                    split, return_variance=True)
-                forecast, fc_valid = aggregate_ensemble(
-                    stacked, stacked_valid, args.mode, args.risk_lambda,
-                    aleatoric_var=avar)
-            else:
-                stacked, stacked_valid = ens.predict(split)
-                forecast, fc_valid = aggregate_ensemble(
-                    stacked, stacked_valid, args.mode, args.risk_lambda)
-        else:
-            from lfm_quant_tpu.train.loop import load_trainer
-            trainer, splits = load_trainer(args.run_dir)
-            if args.mc_samples > 0:
-                if args.mode == "mean_minus_total_std":
-                    ap.error("--mode mean_minus_total_std is not "
-                             "combinable with --mc-samples (dropout "
-                             "samples carry no aleatoric head variance); "
-                             "use --mode mean_minus_std")
-                stacked, fc_valid = trainer.predict(
-                    split, mc_samples=args.mc_samples)
-                forecast, fc_valid = aggregate_ensemble(
-                    stacked, fc_valid, args.mode, args.risk_lambda)
-            elif args.mode == "mean_minus_total_std":
-                # Single heteroscedastic model: no epistemic seed axis —
-                # the penalty reduces to the aleatoric head alone.
-                fc, avar, fc_valid = trainer.predict(
-                    split, return_variance=True)
-                forecast, fc_valid = aggregate_ensemble(
-                    fc[None], fc_valid, args.mode, args.risk_lambda,
-                    aleatoric_var=avar[None])
-            elif args.mode != "mean":
-                ap.error(f"--mode {args.mode} needs stacked forecasts: "
-                         "an ensemble run dir or --mc-samples")
-            else:
-                forecast, fc_valid = trainer.predict(split)
+        from lfm_quant_tpu.train.forecast import (is_ensemble_run_dir,
+                                                  load_forecaster,
+                                                  run_forecast)
+
+        if is_ensemble_run_dir(args.run_dir) and args.mc_samples > 0:
+            # Validate BEFORE load_forecaster restores every seed
+            # checkpoint (minutes on a real ensemble run dir).
+            ap.error("--mc-samples applies to single-model run dirs "
+                     "only; this is a seed ensemble — its uncertainty "
+                     "comes from the seeds (use --mode mean_minus_std "
+                     "directly)")
+        model, splits, is_ensemble = load_forecaster(args.run_dir)
+        forecast, fc_valid = run_forecast(
+            model, is_ensemble, mode=args.mode,
+            risk_lambda=args.risk_lambda, mc_samples=args.mc_samples,
+            error=ap.error, split=args.split or "test")
         panel = splits.panel
 
     report = run_backtest(

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Live-forecast entry point: trained checkpoint(s) → rankings for months
+whose realized outcome is NOT yet observable.
+
+The production half the backtest cannot serve: ``backtest.py`` scores
+anchors against realized targets, so eligibility requires
+``target_valid`` and the last ``horizon`` months of the panel — exactly
+the cross-sections a user trades on — are unreachable by construction.
+This CLI predicts with ``require_target=False`` (window-validity only;
+see ``data/windows.py anchor_index``), the deployment step of the
+reference's research→production workflow (SURVEY.md §4.3's forecast
+stage, decoupled from the simulation stage).
+
+Usage:
+    python forecast.py --run-dir runs/c2_lstm_single/seed0
+    python forecast.py --run-dir runs/c5_lstm_ensemble64/ensemble \\
+        --mode mean_minus_std --csv live_ranks.csv
+    python forecast.py --run-dir ... --from-date 202401 --to-date 202406
+
+Defaults to the panel's live block (the trailing ``horizon`` months).
+Writes an npz (forecast [N, T], valid [N, T], dates, firm_ids) and/or a
+long-format CSV of per-month rankings; prints the latest month's top
+names.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _month_index(dates: np.ndarray, yyyymm: int, name: str) -> int:
+    ix = np.nonzero(dates == yyyymm)[0]
+    if ix.size == 0:
+        raise SystemExit(
+            f"{name} {yyyymm} not in the panel (spans "
+            f"{int(dates[0])}..{int(dates[-1])})")
+    return int(ix[0])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--run-dir", required=True,
+                    help="run directory written by train.py (single seed "
+                         "or ensemble — auto-detected)")
+    ap.add_argument("--from-date", type=int, default=None,
+                    help="first anchor month, YYYYMM inclusive (default: "
+                         "start of the live block — the panel's last "
+                         "`horizon` months)")
+    ap.add_argument("--to-date", type=int, default=None,
+                    help="last anchor month, YYYYMM inclusive (default: "
+                         "panel end)")
+    ap.add_argument("--mode", default="mean",
+                    choices=("mean", "mean_minus_std",
+                             "mean_minus_total_std"),
+                    help="ensemble aggregation (as in backtest.py)")
+    ap.add_argument("--risk-lambda", type=float, default=1.0)
+    ap.add_argument("--mc-samples", type=int, default=0,
+                    help="MC-dropout samples (single-model run dirs with "
+                         "dropout > 0)")
+    ap.add_argument("--out", help="write forecasts npz here")
+    ap.add_argument("--csv", help="write long-format rankings CSV here "
+                                  "(firm_id,yyyymm,forecast,rank)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="names to print for the latest month")
+    args = ap.parse_args(argv)
+
+    from lfm_quant_tpu.data import anchor_index
+    from lfm_quant_tpu.train.forecast import (is_ensemble_run_dir,
+                                              load_forecaster, run_forecast)
+
+    if is_ensemble_run_dir(args.run_dir) and args.mc_samples > 0:
+        # Validate BEFORE load_forecaster restores every seed checkpoint.
+        ap.error("--mc-samples applies to single-model run dirs only")
+    model, splits, is_ensemble = load_forecaster(args.run_dir)
+    panel = splits.panel
+
+    # Default range: the live block — anchors past the last observable
+    # target. End-exclusive month-index range for predict().
+    lo = (_month_index(panel.dates, args.from_date, "--from-date")
+          if args.from_date else max(0, panel.n_months - panel.horizon))
+    hi = (_month_index(panel.dates, args.to_date, "--to-date") + 1
+          if args.to_date else panel.n_months)
+    if lo >= hi:
+        ap.error(
+            f"empty forecast range: it runs {int(panel.dates[min(lo, panel.n_months - 1)])}"
+            f"..{int(panel.dates[hi - 1])} after resolution"
+            + ("" if args.from_date else
+               " (--from-date defaults to the live block, the panel's "
+               f"last {panel.horizon} months — pass an explicit "
+               "--from-date at or before --to-date for historical "
+               "forecasts)"))
+
+    # Pre-check: predict()'s sampler raises a raw ValueError on an empty
+    # range; answer the common operator mistake with its actual cause.
+    d = model.cfg.data
+    elig = anchor_index(panel, d.window, d.min_valid_months,
+                        require_target=False)
+    if not elig[:, lo:hi].any():
+        raise SystemExit(
+            "no eligible anchors in the requested range (firms need "
+            "enough lookback history even without a target)")
+
+    forecast, valid = run_forecast(
+        model, is_ensemble, mode=args.mode, risk_lambda=args.risk_lambda,
+        mc_samples=args.mc_samples, error=ap.error,
+        date_range=(lo, hi), require_target=False)
+
+    months = [t for t in range(lo, hi) if valid[:, t].any()]
+
+    if args.out:
+        np.savez_compressed(args.out, forecast=forecast, valid=valid,
+                            dates=panel.dates, firm_ids=panel.firm_ids)
+        print(f"wrote {args.out}")
+    if args.csv:
+        with open(args.csv, "w") as fh:
+            fh.write("firm_id,yyyymm,forecast,rank\n")
+            for t in months:
+                ix = np.nonzero(valid[:, t])[0]
+                order = ix[np.argsort(-forecast[ix, t])]
+                for rank, i in enumerate(order, 1):
+                    fh.write(f"{int(panel.firm_ids[i])},"
+                             f"{int(panel.dates[t])},"
+                             f"{forecast[i, t]:.6f},{rank}\n")
+        print(f"wrote {args.csv}")
+
+    t = months[-1]
+    ix = np.nonzero(valid[:, t])[0]
+    order = ix[np.argsort(-forecast[ix, t])][:args.top]
+    n_live = sum(1 for m in months if not panel.target_valid[:, m].any())
+    print(f"{len(months)} forecast month(s) {int(panel.dates[months[0]])}"
+          f"..{int(panel.dates[t])} ({n_live} live); latest month "
+          f"{int(panel.dates[t])}: {ix.size} names")
+    for rank, i in enumerate(order, 1):
+        print(f"  #{rank:<3d} firm {int(panel.firm_ids[i]):>8d}  "
+              f"forecast {forecast[i, t]:+.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

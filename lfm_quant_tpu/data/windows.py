@@ -73,7 +73,8 @@ def rolling_valid_count(valid: np.ndarray, window: int) -> np.ndarray:
 
 
 def anchor_index(
-    panel: Panel, window: int, min_valid_months: Optional[int] = None
+    panel: Panel, window: int, min_valid_months: Optional[int] = None,
+    require_target: bool = True,
 ) -> np.ndarray:
     """Eligibility matrix of window anchors.
 
@@ -82,11 +83,19 @@ def anchor_index(
     contains at least ``min_valid_months`` valid months (default: W//2, so
     young firms with ≥30 months of history still train, padded+masked, which
     matches the reference's padding of short histories per SURVEY.md §3).
+
+    ``require_target=False`` drops the target-observability conjunct —
+    LIVE-forecast eligibility: the model only needs the lookback window,
+    and the anchors a production user wants ranked are exactly the last
+    ``horizon`` months where ``target_valid`` is False by construction.
+    Training/backtest paths must keep the default (scoring an anchor
+    needs the realized outcome).
     """
     if min_valid_months is None:
         min_valid_months = max(1, window // 2)
     total = rolling_valid_count(panel.valid, window)
-    return panel.target_valid & (total >= min_valid_months) & panel.valid
+    elig = (total >= min_valid_months) & panel.valid
+    return elig if not require_target else elig & panel.target_valid
 
 
 class DateBatchSampler:
@@ -109,6 +118,7 @@ class DateBatchSampler:
         min_cross_section: int = 8,
         date_range: Optional[tuple] = None,
         engine: str = "python",
+        require_target: bool = True,
     ):
         """``date_range=(lo, hi)`` restricts ANCHOR months to panel column
         indices [lo, hi) — the split mechanism (PanelSplits): windows still
@@ -140,7 +150,8 @@ class DateBatchSampler:
             raise ValueError(
                 f"engine must be python|native|auto, got {engine!r}")
         self.engine = engine
-        eligible = anchor_index(panel, window, min_valid_months)
+        eligible = anchor_index(panel, window, min_valid_months,
+                                require_target=require_target)
         if date_range is not None:
             lo, hi = date_range
             if not (0 <= lo < hi <= panel.n_months):

@@ -344,7 +344,7 @@ class EnsembleTrainer:
 
     def predict(self, split: str = "test",
                 date_range: Optional[Tuple[int, int]] = None,
-                return_variance: bool = False):
+                return_variance: bool = False, require_target: bool = True):
         """Stacked forecasts [S, N, T] + shared validity [N, T] over the
         split's anchor range (or an explicit month-index ``date_range`` —
         the walk-forward fold window), for the backtest's ensemble
@@ -354,6 +354,9 @@ class EnsembleTrainer:
         returns per-seed aleatoric variances [S, N, T]:
         (forecasts, variances, valid) — consumed by
         ``aggregate_ensemble(mode="mean_minus_total_std")``.
+
+        ``require_target=False`` includes LIVE anchors (no observable
+        outcome yet) — see Trainer.predict / the forecast.py CLI.
         """
         d = self.cfg.data
         panel = self.splits.panel
@@ -361,6 +364,7 @@ class EnsembleTrainer:
             panel, d.window, 1, d.firms_per_date, seed=0,
             min_valid_months=d.min_valid_months, min_cross_section=1,
             date_range=date_range or self.splits.range_of(split),
+            require_target=require_target,
         )
         out = np.zeros((self.n_seeds, panel.n_firms, panel.n_months), np.float32)
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)

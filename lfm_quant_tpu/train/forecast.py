@@ -1,0 +1,97 @@
+"""Shared run-dir → aggregated-forecast dispatch for the two forecast
+consumers: ``backtest.py`` (historical anchors, scored against realized
+outcomes) and ``forecast.py`` (live anchors, ``require_target=False``).
+One copy of the ensemble/MC-dropout/heteroscedastic branching and its
+validation rules — the CLIs were growing drifting duplicates
+(round-4 advisor finding)."""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+
+def _raise_system_exit(msg: str):
+    raise SystemExit(msg)
+
+
+def is_ensemble_run_dir(run_dir: str) -> bool:
+    """Cheap ensemble.flag stat — lets CLIs validate flag combinations
+    (e.g. --mc-samples against an ensemble) BEFORE load_forecaster
+    restores every seed checkpoint, which takes minutes on a real
+    ensemble run dir."""
+    return os.path.exists(os.path.join(run_dir, "ensemble.flag"))
+
+
+def load_forecaster(run_dir: str):
+    """Load a run dir's trained model (single seed or ensemble —
+    auto-detected via the ``ensemble.flag`` marker).
+
+    Returns ``(model, splits, is_ensemble)`` where ``model`` is a
+    ``Trainer`` or ``EnsembleTrainer`` with its best checkpoint restored.
+    Loading is separate from forecasting so callers can inspect the panel
+    (date ranges, live block) before choosing what to predict."""
+    is_ensemble = is_ensemble_run_dir(run_dir)
+    if is_ensemble:
+        from lfm_quant_tpu.train.ensemble import load_ensemble
+
+        model, splits = load_ensemble(run_dir)
+    else:
+        from lfm_quant_tpu.train.loop import load_trainer
+
+        model, splits = load_trainer(run_dir)
+    return model, splits, is_ensemble
+
+
+def run_forecast(
+    model,
+    is_ensemble: bool,
+    mode: str = "mean",
+    risk_lambda: float = 1.0,
+    mc_samples: int = 0,
+    error: Optional[Callable[[str], None]] = None,
+    **predict_kw,
+):
+    """Aggregated forecast from a loaded model.
+
+    ``predict_kw`` flows into ``predict()``: ``split=`` for the backtest
+    path, ``date_range=``/``require_target=False`` for the live path.
+    ``error`` reports invalid flag combinations (argparse's ``ap.error``
+    from the CLIs; defaults to raising SystemExit) — it must not return.
+
+    Returns ``(forecast [N, T], valid [N, T])``.
+    """
+    from lfm_quant_tpu.backtest.engine import aggregate_ensemble
+
+    error = error or _raise_system_exit
+    if is_ensemble:
+        if mc_samples > 0:
+            error("--mc-samples applies to single-model run dirs only; "
+                  "this is a seed ensemble — its uncertainty comes from "
+                  "the seeds (use --mode mean_minus_std directly)")
+        if mode == "mean_minus_total_std":
+            stacked, avar, valid = model.predict(return_variance=True,
+                                                 **predict_kw)
+            return aggregate_ensemble(stacked, valid, mode, risk_lambda,
+                                      aleatoric_var=avar)
+        stacked, valid = model.predict(**predict_kw)
+        return aggregate_ensemble(stacked, valid, mode, risk_lambda)
+
+    if mc_samples > 0:
+        if mode == "mean_minus_total_std":
+            error("--mode mean_minus_total_std is not combinable with "
+                  "--mc-samples (dropout samples carry no aleatoric "
+                  "head variance); use --mode mean_minus_std")
+        stacked, valid = model.predict(mc_samples=mc_samples, **predict_kw)
+        return aggregate_ensemble(stacked, valid, mode, risk_lambda)
+    if mode == "mean_minus_total_std":
+        # Single heteroscedastic model: no epistemic seed axis — the
+        # penalty reduces to the aleatoric head alone.
+        fc, avar, valid = model.predict(return_variance=True, **predict_kw)
+        return aggregate_ensemble(fc[None], valid, mode, risk_lambda,
+                                  aleatoric_var=avar[None])
+    if mode != "mean":
+        error(f"--mode {mode} needs stacked forecasts: an ensemble run "
+              "dir or --mc-samples")
+    forecast, valid = model.predict(**predict_kw)
+    return forecast, valid

@@ -818,7 +818,7 @@ class Trainer:
 
     def predict(self, split: str = "test", mc_samples: int = 0,
                 mc_seed: int = 0, date_range: Optional[Tuple[int, int]] = None,
-                return_variance: bool = False):
+                return_variance: bool = False, require_target: bool = True):
         """Forecasts for every eligible anchor in a split's date range.
 
         Returns (forecast [N, T] float32, pred_valid [N, T] bool) over the
@@ -842,6 +842,12 @@ class Trainer:
         ``date_range`` (month-INDEX pair, end-exclusive) overrides the
         split's anchor range — the walk-forward harness predicts each
         fold's bounded out-of-sample block with it.
+
+        ``require_target=False`` forecasts LIVE anchors too — months whose
+        realized outcome is not (yet) observable, which the default
+        eligibility excludes. The forecast.py CLI's path: the last
+        ``horizon`` months of the panel are exactly the rankings a
+        production user trades on.
         """
         d = self.cfg.data
         panel = self.splits.panel
@@ -854,6 +860,7 @@ class Trainer:
             panel, d.window, 1, d.firms_per_date, seed=0,
             min_valid_months=d.min_valid_months, min_cross_section=1,
             date_range=date_range or self.splits.range_of(split),
+            require_target=require_target,
         )
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
         b = sampler.stacked_cross_sections()

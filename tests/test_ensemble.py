@@ -22,7 +22,7 @@ def ens_cfg(tmp, n_seeds=4, **over):
     base = dict(
         name="t_ens",
         data=DataConfig(n_firms=150, n_months=150, n_features=5, window=12,
-                        dates_per_batch=4, firms_per_date=48),
+                        dates_per_batch=4, firms_per_date=48, panel_seed=31),
         model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
         optim=OptimConfig(lr=3e-3, epochs=3, warmup_steps=5,
                           early_stop_patience=3, loss="mse"),
@@ -52,6 +52,23 @@ def test_ensemble_trains_and_recovers_signal(fitted):
     assert summary["best_val_ic"] > 0.1
     hist = summary["history"]
     assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+
+
+def test_ensemble_predicts_live_anchors(fitted):
+    """The seed-stacked predict reaches the live block too (forecast.py's
+    ensemble path): target-free anchors get forecasts from every seed."""
+    import numpy as np
+
+    _, _, trainer, splits = fitted
+    panel = splits.panel
+    live_lo = panel.n_months - panel.horizon
+    stacked, valid = trainer.predict(
+        date_range=(live_lo, panel.n_months), require_target=False)
+    assert valid.any() and not panel.target_valid[:, live_lo:].any()
+    assert stacked.shape[0] == trainer.n_seeds
+    assert np.isfinite(stacked[:, valid]).all()
+    # Seeds genuinely differ on live anchors (independent members).
+    assert np.std(stacked[:, valid], axis=0).mean() > 0
 
 
 def test_members_differ(fitted):

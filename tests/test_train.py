@@ -18,7 +18,7 @@ def tiny_cfg(**over):
         name="t_mlp",
         data=DataConfig(
             n_firms=200, n_months=160, n_features=5, window=12,
-            dates_per_batch=4, firms_per_date=64,
+            dates_per_batch=4, firms_per_date=64, panel_seed=21,
         ),
         model=ModelConfig(kind="mlp", kwargs={"hidden": (32,)}),
         optim=OptimConfig(lr=3e-3, epochs=6, warmup_steps=10,
@@ -123,6 +123,71 @@ def test_predict_covers_eligible_test_anchors(fitted):
     p = splits.panel
     ic = np.corrcoef(fc[fc_valid], p.targets[fc_valid])[0, 1]
     assert ic > 0.1, f"test-set forecast useless: corr={ic:.3f}"
+
+
+def test_predict_live_anchors_without_targets(fitted):
+    """require_target=False must reach the panel's live block — the last
+    `horizon` months have NO observable targets by construction, which
+    default eligibility excludes — while agreeing exactly with the
+    default path on every shared anchor (same model, same per-firm
+    forward; only eligibility differs)."""
+    _, _, trainer, splits = fitted
+    panel = splits.panel
+    live_lo = panel.n_months - panel.horizon
+    rng = (live_lo - 3, panel.n_months)
+
+    fc_d, val_d = trainer.predict(date_range=rng)
+    fc_l, val_l = trainer.predict(date_range=rng, require_target=False)
+
+    # Live eligibility strictly extends backtest eligibility...
+    assert (val_d & ~val_l).sum() == 0
+    # ...and actually reaches the live block (zero targets there).
+    live = val_l[:, live_lo:]
+    assert live.any(), "no live anchors forecast"
+    assert not panel.target_valid[:, live_lo:].any()
+    assert not val_d[:, live_lo:].any()
+    assert np.isfinite(fc_l[val_l]).all()
+    # Shared anchors: bitwise-identical forecasts.
+    shared = val_d & val_l
+    assert shared.any()
+    np.testing.assert_array_equal(fc_d[shared], fc_l[shared])
+
+
+@pytest.mark.fast
+def test_forecast_cli_ranks_live_months(fitted, tmp_path, capsys):
+    """forecast.py end-to-end: run dir → live rankings (npz + csv), the
+    deployment surface backtest.py cannot provide."""
+    import csv as _csv
+
+    import forecast as forecast_cli
+
+    cfg, summary, trainer, splits = fitted
+    run_dir = summary["run_dir"]
+    out = tmp_path / "fc.npz"
+    csv_path = tmp_path / "fc.csv"
+    rc = forecast_cli.main(["--run-dir", run_dir, "--out", str(out),
+                            "--csv", str(csv_path), "--top", "3"])
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    assert "live" in stdout and "#1" in stdout
+
+    data = np.load(out)
+    panel = splits.panel
+    live_lo = panel.n_months - panel.horizon
+    assert data["valid"][:, live_lo:].any()
+    assert data["forecast"].shape == (panel.n_firms, panel.n_months)
+
+    with open(csv_path) as fh:
+        rows = list(_csv.DictReader(fh))
+    assert rows, "empty rankings csv"
+    months = {int(r["yyyymm"]) for r in rows}
+    assert int(panel.dates[-1]) in months  # the very last month is ranked
+    # Ranks are 1..n and ordered by forecast within each month.
+    last = [r for r in rows if int(r["yyyymm"]) == int(panel.dates[-1])]
+    ranks = [int(r["rank"]) for r in last]
+    assert ranks == list(range(1, len(last) + 1))
+    fcs = [float(r["forecast"]) for r in last]
+    assert fcs == sorted(fcs, reverse=True)
 
 
 def test_early_stopping_triggers(panel, tmp_path):

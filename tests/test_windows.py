@@ -40,6 +40,21 @@ def test_anchor_index_matches_bruteforce(panel):
         assert bool(elig[i, j]) == expect, (i, j)
 
 
+def test_anchor_index_live_mode_drops_only_target_conjunct(panel):
+    """require_target=False (the forecast.py live path) must equal the
+    default eligibility with exactly the target_valid conjunct removed —
+    reaching the last-`horizon`-month live block and nothing else new."""
+    strict = anchor_index(panel, WINDOW, min_valid_months=12)
+    live = anchor_index(panel, WINDOW, min_valid_months=12,
+                        require_target=False)
+    np.testing.assert_array_equal(live & panel.target_valid, strict)
+    extra = live & ~strict
+    assert extra.any()
+    assert not panel.target_valid[extra].any()
+    # The panel's final month — never target-eligible — is forecastable.
+    assert live[:, -1].any() and not strict[:, -1].any()
+
+
 def test_sampler_layout_and_eligibility(panel):
     s = DateBatchSampler(panel, WINDOW, dates_per_batch=4, firms_per_date=16, seed=5)
     elig = anchor_index(panel, WINDOW)
