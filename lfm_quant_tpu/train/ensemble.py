@@ -284,13 +284,25 @@ class EnsembleTrainer:
         return {"ic_per_seed": per_seed, "ic_mean": float(per_seed.mean()),
                 "ic_std": float(per_seed.std())}
 
-    def fit(self, resume: bool = False) -> Dict[str, Any]:
+    def fit(self, resume: bool = False, init_params=None) -> Dict[str, Any]:
         """Lock-step ensemble training with crash resume (ckpt/latest every
-        epoch) and best-model tracking (ckpt/best) — see Trainer.fit."""
+        epoch) and best-model tracking (ckpt/best) — see Trainer.fit.
+
+        ``init_params``: seed-stacked [S, ...] params to start from (the
+        walk-forward warm start); optimizer state restarts fresh."""
         cfg = self.cfg
         if cfg.optim.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {cfg.optim.epochs}")
         state = self.init_state()
+        if init_params is not None:
+            from lfm_quant_tpu.train.loop import graft_params
+
+            # vmapped tx.init keeps the opt-state tree IDENTICAL to
+            # init_state's (per-seed count leaves etc.), which the jitted
+            # step's structure contract relies on.
+            state = graft_params(state, init_params,
+                                 jax.vmap(self.inner.tx.init),
+                                 self._commit_state)
         harness = FitHarness(self.run_dir, cfg.optim.epochs,
                              cfg.optim.early_stop_patience,
                              min(s.batches_per_epoch() for s in self.samplers))

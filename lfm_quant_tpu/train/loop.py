@@ -61,6 +61,26 @@ class TrainState(NamedTuple):
     rng: jax.Array
 
 
+def graft_params(state: TrainState, init_params, tx_init, commit):
+    """Fresh-init state with ``init_params`` grafted in — the walk-forward
+    warm start. The optimizer restarts from zero moments (a new fold is a
+    new optimization problem), only the weights carry over; ``tx_init``
+    rebuilds the opt state with the SAME tree structure the caller's
+    ``init_state`` produces (plain for Trainer, vmapped for the seed-
+    stacked ensemble) and ``commit`` re-places on the caller's mesh.
+    Tree/shape mismatches get a clear error instead of a deep jit trace
+    failure."""
+    want = jax.tree.map(lambda a: (a.shape, a.dtype), state.params)
+    got = jax.tree.map(lambda a: (a.shape, a.dtype), init_params)
+    if want != got:
+        raise ValueError(
+            "init_params does not match this trainer's parameter "
+            f"tree/shapes/dtypes — warm starts require the same model "
+            f"config across folds (expected {want}, got {got})")
+    params = jax.tree.map(jnp.asarray, init_params)
+    return commit(TrainState(params, tx_init(params), state.step, state.rng))
+
+
 def make_loss_fn(name: str) -> Callable:
     """Resolve a loss name to fn(outputs, targets, weights) → scalar.
 
@@ -671,6 +691,10 @@ class Trainer:
             return state
         return jax.device_put(state, replicated(mesh))
 
+    def _warm_state(self, state: TrainState, init_params) -> TrainState:
+        return graft_params(state, init_params, self.tx.init,
+                            self._commit_state)
+
     def init_state(self, rng: Optional[jax.Array] = None) -> TrainState:
         if rng is None:
             rng = jax.random.key(self.cfg.seed)
@@ -753,16 +777,23 @@ class Trainer:
             "n_months": int(counts.size),
         }
 
-    def fit(self, resume: bool = False) -> Dict[str, Any]:
+    def fit(self, resume: bool = False, init_params=None) -> Dict[str, Any]:
         """Train with early stopping; ``resume=True`` continues from the
         latest per-epoch checkpoint after a crash/preemption (SURVEY.md §6
         "failure detection / recovery": Orbax resume-from-latest — two
         checkpoint lines are kept, ``ckpt/latest`` every epoch for recovery
-        and ``ckpt/best`` on val-IC improvement for the final model)."""
+        and ``ckpt/best`` on val-IC improvement for the final model).
+
+        ``init_params``: start from these params instead of a fresh init —
+        the walk-forward warm start (optimizer state and step counter are
+        fresh either way; a crash resume takes precedence since the latest
+        checkpoint already embodies the warm start)."""
         cfg = self.cfg
         if cfg.optim.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {cfg.optim.epochs}")
         state = self.init_state()
+        if init_params is not None:
+            state = self._warm_state(state, init_params)
         harness = FitHarness(self.run_dir, cfg.optim.epochs,
                              cfg.optim.early_stop_patience,
                              self.train_sampler.batches_per_epoch())

@@ -81,7 +81,8 @@ def walkforward_folds(panel: Panel, start: int, step_months: int,
 def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                     step_months: int = 12, val_months: int = 24,
                     n_folds: Optional[int] = None, out_dir: Optional[str] = None,
-                    echo: bool = False, resume: bool = False
+                    echo: bool = False, resume: bool = False,
+                    warm_start: bool = False
                     ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
     """Train a model (or seed ensemble, ``cfg.n_seeds > 1``) per fold and
     stitch the out-of-sample forecasts.
@@ -103,6 +104,20 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     ``resume=True`` (needs ``out_dir``) skips folds already recorded in
     the progress snapshot and resumes the in-flight fold from its own
     ``ckpt/latest`` — crash recovery for multi-fold runs.
+
+    ``warm_start=True`` initializes each fold's weights from the previous
+    fold's final state instead of a fresh draw (optimizer restarts
+    fresh) — the early-stop BEST state when fold run dirs exist
+    (``out_dir`` set: ``fit`` restores ckpt/best at finalize), the
+    last-epoch state otherwise (no run dir → no best checkpoint line).
+    No lookahead: fold k-1 trained on strictly earlier data than fold k's
+    prediction window, so the out-of-sample property is intact — the carry
+    only moves the fold's starting point closer to a solution, the
+    wall-clock lever for multi-decade retraining sweeps. Off by default
+    (fresh folds are independent draws, the reference protocol). A fold
+    skipped by ``resume`` breaks the carry chain — the next trained fold
+    falls back to a fresh init (its predecessor's in-memory params are
+    gone; correctness is unaffected).
     """
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
     from lfm_quant_tpu.train.loop import Trainer
@@ -154,6 +169,7 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                 raise ValueError("resume snapshot shape mismatch "
                                  f"{forecast.shape} — n_seeds changed?")
 
+    prev_params = None
     for k, (train_end, val_end, pred_range) in enumerate(folds):
         if k < len(records):
             continue  # fold already completed in a previous run
@@ -164,7 +180,13 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
         fold_cfg = dataclasses.replace(cfg, seed=cfg.seed + 1000 * k)
         trainer = (EnsembleTrainer if ensemble else Trainer)(
             fold_cfg, splits, run_dir=run_dir, echo=echo)
-        fit = trainer.fit(resume=resume and run_dir is not None)
+        used_warm = warm_start and prev_params is not None
+        fit = trainer.fit(resume=resume and run_dir is not None,
+                          init_params=prev_params if used_warm else None)
+        if warm_start:
+            # Best state when this fold had a run dir (finalize restored
+            # ckpt/best); the last-epoch state otherwise — see docstring.
+            prev_params = trainer.state.params
         if het:
             fc, avar, v = trainer.predict(date_range=pred_range,
                                           return_variance=True)
@@ -183,6 +205,7 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
             "n_pred_cells": int(v.sum()),
             "best_val_ic": fit["best_val_ic"],
             "epochs_run": fit["epochs_run"],
+            "warm_started": used_warm,
         })
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
@@ -196,6 +219,7 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
         "step_months": step_months,
         "val_months": val_months,
         "n_seeds": cfg.n_seeds,
+        "warm_start": warm_start,
         "oos_months": [int(panel.dates[folds[0][2][0]]),
                        int(panel.dates[folds[-1][2][1] - 1])],
         "folds": records,

@@ -138,6 +138,26 @@ def test_ensemble_beats_or_matches_worst_member(fitted):
     assert ens_ic >= min(member_ics) - 1e-6
 
 
+def test_ensemble_warm_start_fit(panel, tmp_path):
+    """EnsembleTrainer.fit(init_params=...) — the stacked warm start the
+    walk-forward carry uses: training proceeds from the given seed-stacked
+    weights, and a seed-count mismatch fails loudly (the opt-state tree
+    must keep init_state's vmapped structure, so this path has its own
+    branch)."""
+    from lfm_quant_tpu.data.panel import PanelSplits
+
+    splits = PanelSplits.by_date(panel, 198001, 198201)
+    donor = EnsembleTrainer(ens_cfg(tmp_path / "a", n_seeds=2), splits)
+    donor_params = donor.init_state().params
+    tr = EnsembleTrainer(ens_cfg(tmp_path / "b", n_seeds=2), splits)
+    fit = tr.fit(init_params=donor_params)
+    assert np.isfinite(fit["best_val_ic"])
+    # Mismatched seed count: loud error, not a jit structure failure.
+    tr3 = EnsembleTrainer(ens_cfg(tmp_path / "c", n_seeds=3), splits)
+    with pytest.raises(ValueError, match="does not match"):
+        tr3.fit(init_params=donor_params)
+
+
 def test_requires_two_seeds(panel, tmp_path):
     from lfm_quant_tpu.data import PanelSplits
     splits = PanelSplits.by_date(panel, 197910, 198101)

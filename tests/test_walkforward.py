@@ -87,6 +87,54 @@ def test_walkforward_stitches_oos_only(panel, tmp_path):
     assert (tmp_path / "wf" / "config.json").exists()
 
 
+def test_walkforward_warm_start_carries_params(panel, tmp_path):
+    """warm_start=True must initialize fold k>0 from fold k-1's best
+    state: prove it by running with epochs so small the carried weights
+    dominate — fold 1's warm model must equal neither a fresh-init fold
+    nor drift far from fold 0's solution — and by the per-fold records."""
+    cfg = _cfg(tmp_path)
+    fc_w, valid_w, summary_w = run_walkforward(
+        cfg, panel, start=198001, step_months=12, val_months=24, n_folds=2,
+        out_dir=str(tmp_path / "warm"), warm_start=True)
+    assert summary_w["warm_start"] is True
+    assert [r["warm_started"] for r in summary_w["folds"]] == [False, True]
+
+    # The stitched forecasts differ from the cold protocol's (same seeds,
+    # same schedule — only the fold-1 init changed).
+    fc_c, valid_c, summary_c = run_walkforward(
+        cfg, panel, start=198001, step_months=12, val_months=24, n_folds=2,
+        out_dir=str(tmp_path / "cold"))
+    assert [r["warm_started"] for r in summary_c["folds"]] == [False, False]
+    np.testing.assert_array_equal(valid_w, valid_c)
+    fold1_months = valid_w.copy()
+    lo = int(np.searchsorted(panel.dates, month_add(198001, 24)))
+    hi = int(np.searchsorted(panel.dates, month_add(198001, 36)))
+    fold1_months[:, :] = False
+    fold1_months[:, hi:] = valid_w[:, hi:]  # fold 1's prediction window
+    assert fold1_months.any()
+    assert not np.array_equal(fc_w[fold1_months], fc_c[fold1_months])
+    # Fold 0 predates any carry: identical under both protocols.
+    fold0_months = valid_w & ~fold1_months
+    np.testing.assert_array_equal(fc_w[fold0_months], fc_c[fold0_months])
+
+
+def test_warm_start_fit_rejects_mismatched_params(panel, tmp_path):
+    """A warm start across different model configs must fail loudly, not
+    deep inside a jit trace."""
+    from lfm_quant_tpu.data.panel import PanelSplits
+    from lfm_quant_tpu.train.loop import Trainer
+
+    splits = PanelSplits.by_date(panel, 198001, 198201)
+    small = Trainer(_cfg(tmp_path / "a"), splits)
+    big_cfg = _cfg(tmp_path / "b")
+    big_cfg = dataclasses.replace(
+        big_cfg, model=dataclasses.replace(big_cfg.model,
+                                           kwargs={"hidden": (32,)}))
+    big = Trainer(big_cfg, splits)
+    with pytest.raises(ValueError, match="does not match"):
+        big.fit(init_params=small.init_state().params)
+
+
 def test_walkforward_ensemble_stacks_seeds(panel, tmp_path):
     cfg = _cfg(tmp_path, n_seeds=2)
     fc, valid, summary = run_walkforward(

@@ -52,12 +52,18 @@ def main(argv=None) -> int:
     ap.add_argument("--wf-folds", type=int, default=None,
                     help="cap the number of folds (default: run to the "
                          "panel's end)")
+    ap.add_argument("--wf-warm-start", action="store_true",
+                    help="initialize each fold's weights from the previous "
+                         "fold's best state (optimizer restarts fresh) — "
+                         "the wall-clock lever for long retraining sweeps; "
+                         "no lookahead (the prior fold saw strictly "
+                         "earlier data)")
     args = ap.parse_args(argv)
     if args.walk_forward is None and (
             args.wf_start is not None or args.wf_folds is not None
-            or args.wf_val_months != 24):
-        ap.error("--wf-start/--wf-val-months/--wf-folds need "
-                 "--walk-forward STEP_MONTHS")
+            or args.wf_val_months != 24 or args.wf_warm_start):
+        ap.error("--wf-start/--wf-val-months/--wf-folds/--wf-warm-start "
+                 "need --walk-forward STEP_MONTHS")
 
     # Import late so --help works instantly without initializing JAX.
     import dataclasses
@@ -114,7 +120,8 @@ def main(argv=None) -> int:
             _, _, summary = run_walkforward(
                 cfg, panel, start=start, step_months=args.walk_forward,
                 val_months=args.wf_val_months, n_folds=args.wf_folds,
-                out_dir=wf_dir, echo=args.echo, resume=args.resume)
+                out_dir=wf_dir, echo=args.echo, resume=args.resume,
+                warm_start=args.wf_warm_start)
             summary["run_dir"] = wf_dir
         elif cfg.n_seeds > 1:
             from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
