@@ -285,11 +285,15 @@ def model_kwargs(cfg: RunConfig, mesh=None,
     if cfg.is_heteroscedastic:
         kw["heteroscedastic"] = True
     if cfg.model.kind in ("lstm", "gru"):
+        # Factorized recurrences (PAPERS.md F-/G-LSTM: factor_rank /
+        # n_groups kwargs) run on the XLA scan only — the Pallas kernels'
+        # VMEM/MXU layout assumes dense gate weights.
+        factored = bool(kw.get("factor_rank")) or kw.get("n_groups", 1) > 1
         if "scan_impl" not in kw:
             impl = cfg.model.scan_impl
             if impl == "auto":
                 impl = ("pallas_fused" if jax.default_backend() == "tpu"
-                        else "xla")
+                        and not factored else "xla")
             kw["scan_impl"] = impl
         if force_xla_scan:
             kw["scan_impl"] = "xla"

@@ -32,6 +32,85 @@ import jax.numpy as jnp
 from lfm_quant_tpu.models.heads import ForecastHead
 
 
+class LowRankDense(nn.Module):
+    """``W ≈ U @ V`` factorized projection — the "F-LSTM" factorization
+    trick (PAPERS.md "Factorization tricks for LSTM networks"): params and
+    FLOPs drop from ``in·out`` to ``rank·(in + out)``, worthwhile when
+    ``rank < in·out/(in+out)``."""
+
+    features: int
+    rank: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        u = nn.Dense(self.rank, use_bias=False, dtype=self.dtype,
+                     name="u")(x)
+        return nn.Dense(self.features, use_bias=self.use_bias,
+                        dtype=self.dtype, name="v")(u)
+
+
+class GroupedDense(nn.Module):
+    """Block-diagonal projection — the "G-LSTM" grouping trick
+    (PAPERS.md): the feature axis splits into ``n_groups`` independent
+    slices, each with its own ``[in/g, out/g]`` kernel (params and FLOPs
+    ÷ g). Output stays in GROUP-MAJOR order; every consumer in this
+    module keeps that layout, so the head simply learns it."""
+
+    features: int
+    n_groups: int
+    use_bias: bool = True
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        g = self.n_groups
+        gin, gout = x.shape[-1] // g, self.features // g
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (g, gin, gout), jnp.float32)
+        xg = x.reshape(x.shape[:-1] + (g, gin))
+        dtype = self.dtype or x.dtype
+        y = jnp.einsum("...gi,gio->...go", xg.astype(dtype),
+                       kernel.astype(dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (g, gout), jnp.float32)
+            y = y + bias.astype(dtype)
+        return y.reshape(x.shape[:-1] + (self.features,))
+
+
+def _proj(features, factor_rank, n_groups, dtype, use_bias, name):
+    """A projection in its dense, low-rank, or grouped form — ONE dispatch
+    shared by the in-scan recurrent projection and the hoisted input
+    projection, so the two addends can never desynchronize layouts."""
+    if factor_rank:
+        return LowRankDense(features, factor_rank, use_bias=use_bias,
+                            dtype=dtype, name=name)
+    if n_groups > 1:
+        return GroupedDense(features, n_groups, use_bias=use_bias,
+                            dtype=dtype, name=name)
+    return nn.Dense(features, use_bias=use_bias, dtype=dtype, name=name)
+
+
+def _hproj(hidden, gate_mult, factor_rank, n_groups, dtype):
+    """The in-scan recurrent projection — always named ``h_proj`` so the
+    variants stay siblings in the param tree."""
+    return _proj(gate_mult * hidden, factor_rank, n_groups, dtype,
+                 use_bias=False, name="h_proj")
+
+
+def _split_gates(gates, n_gates, n_groups, hidden):
+    """Gate slices from a projection output. Grouped layouts interleave
+    (group-major): ``[..., g, n_gates, H/g]`` — each gate is the
+    concatenation of its per-group slices, matching the group-major h."""
+    if n_groups == 1:
+        return jnp.split(gates, n_gates, axis=-1)
+    lead = gates.shape[:-1]
+    gg = gates.reshape(lead + (n_groups, n_gates, hidden // n_groups))
+    return [gg[..., i, :].reshape(lead + (hidden,)) for i in range(n_gates)]
+
+
 class LSTMRecurrence(nn.Module):
     """Recurrent-only LSTM step (input contribution precomputed).
 
@@ -39,20 +118,26 @@ class LSTMRecurrence(nn.Module):
     the hoisted [..., 4H] ifgo input projection and m_t carries a trailing
     singleton dim ([..., 1]) so the scan treats xw and m uniformly on
     axis -2; returns h_t as the per-step output.
+
+    ``factor_rank``/``n_groups``: the PAPERS.md factorization tricks —
+    low-rank (F-LSTM) or block-diagonal (G-LSTM) recurrent projection.
+    The hoisted input projection must use the matching layout (RNNModel
+    arranges this).
     """
 
     hidden: int
     forget_bias: float = 1.0
     dtype: Optional[jnp.dtype] = None
+    factor_rank: Optional[int] = None
+    n_groups: int = 1
 
     @nn.compact
     def __call__(self, carry, inp):
         h, c = carry
         xw, m = inp
-        gates = xw.astype(h.dtype) + nn.Dense(
-            4 * self.hidden, use_bias=False, dtype=self.dtype, name="h_proj"
-        )(h)
-        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        gates = xw.astype(h.dtype) + _hproj(
+            self.hidden, 4, self.factor_rank, self.n_groups, self.dtype)(h)
+        i, f, g, o = _split_gates(gates, 4, self.n_groups, self.hidden)
         c_new = nn.sigmoid(f + self.forget_bias) * c + nn.sigmoid(i) * jnp.tanh(g)
         h_new = nn.sigmoid(o) * jnp.tanh(c_new)
         keep = m.astype(h.dtype)
@@ -62,20 +147,25 @@ class LSTMRecurrence(nn.Module):
 
 
 class GRURecurrence(nn.Module):
-    """Recurrent-only GRU step, reset-after-projection (cuDNN v2) variant."""
+    """Recurrent-only GRU step, reset-after-projection (cuDNN v2) variant.
+
+    ``factor_rank``/``n_groups``: as in LSTMRecurrence.
+    """
 
     hidden: int
     dtype: Optional[jnp.dtype] = None
+    factor_rank: Optional[int] = None
+    n_groups: int = 1
 
     @nn.compact
     def __call__(self, carry, inp):
         (h,) = carry
         xw, m = inp
-        hw = nn.Dense(
-            3 * self.hidden, use_bias=False, dtype=self.dtype, name="h_proj"
-        )(h)
-        xz, xr, xn = jnp.split(xw.astype(h.dtype), 3, axis=-1)
-        hz, hr, hn = jnp.split(hw, 3, axis=-1)
+        hw = _hproj(self.hidden, 3, self.factor_rank, self.n_groups,
+                    self.dtype)(h)
+        xz, xr, xn = _split_gates(xw.astype(h.dtype), 3, self.n_groups,
+                                  self.hidden)
+        hz, hr, hn = _split_gates(hw, 3, self.n_groups, self.hidden)
         z = nn.sigmoid(xz + hz)
         r = nn.sigmoid(xr + hr)
         n = jnp.tanh(xn + r * hn)
@@ -156,12 +246,38 @@ class RNNModel(nn.Module):
     # Batch rows per Pallas grid block (None = rnn_scan's default); the
     # tuning knob scripts/sweep_rnn_blocks.py measures.
     scan_block_b: Optional[int] = None
+    # PAPERS.md factorization tricks (mutually exclusive; XLA scan only —
+    # the Pallas kernels' VMEM/MXU layout assumes dense [H, G·H] weights):
+    # factor_rank → low-rank U·V projections (F-LSTM); n_groups → block-
+    # diagonal group projections (G-LSTM), hidden % n_groups == 0.
+    factor_rank: Optional[int] = None
+    n_groups: int = 1
 
     @nn.compact
     def __call__(self, x, m, deterministic: bool = True):
         if self.cell not in _CELLS:
             raise ValueError(f"cell must be one of {sorted(_CELLS)}")
         rec_cls, gate_mult, carry_n = _CELLS[self.cell]
+        factored = bool(self.factor_rank) or self.n_groups > 1
+        if self.n_groups < 1:
+            raise ValueError(f"n_groups must be >= 1, got {self.n_groups}")
+        if self.factor_rank is not None and self.factor_rank < 1:
+            raise ValueError(
+                f"factor_rank must be >= 1, got {self.factor_rank}")
+        if self.factor_rank and self.n_groups > 1:
+            raise ValueError(
+                "factor_rank and n_groups are alternative factorizations "
+                "— set at most one")
+        if self.n_groups > 1 and self.hidden % self.n_groups:
+            raise ValueError(
+                f"hidden={self.hidden} must divide evenly into "
+                f"n_groups={self.n_groups}")
+        if factored and self.scan_impl != "xla":
+            raise ValueError(
+                "factor_rank/n_groups need scan_impl='xla': the Pallas "
+                "recurrence kernels assume dense gate weights (config "
+                "auto-resolution routes factorized models to the XLA "
+                "scan; don't force a pallas impl on one)")
         compute_dtype = self.dtype or jnp.float32
         batch_shape = x.shape[:-2]
         h = nn.Dense(self.hidden, dtype=self.dtype, name="embed")(
@@ -196,11 +312,12 @@ class RNNModel(nn.Module):
                     block_b=self.scan_block_b,
                 ).reshape(h.shape[:-1] + (self.hidden,))
                 continue
-            # Hoisted input projection: all T steps in one GEMM.
-            xw = nn.Dense(
-                gate_mult * self.hidden, dtype=self.dtype,
-                name=f"{self.cell}_{layer}_xproj",
-            )(h)
+            # Hoisted input projection: all T steps in one GEMM — in the
+            # same (dense/low-rank/grouped) layout as the in-scan gate
+            # projection so the two addends share gate ordering.
+            xw = _proj(gate_mult * self.hidden, self.factor_rank,
+                       self.n_groups, self.dtype, use_bias=True,
+                       name=f"{self.cell}_{layer}_xproj")(h)
             if self.scan_impl == "pallas":
                 from lfm_quant_tpu.ops.pallas_rnn import rnn_scan
 
@@ -223,7 +340,9 @@ class RNNModel(nn.Module):
                 split_rngs={"params": False},
                 in_axes=-2,   # time axis of (xw, m) inputs
                 out_axes=-2,
-            )(hidden=self.hidden, dtype=self.dtype, name=f"{self.cell}_{layer}")
+            )(hidden=self.hidden, dtype=self.dtype,
+              factor_rank=self.factor_rank, n_groups=self.n_groups,
+              name=f"{self.cell}_{layer}")
             carry = (zeros,) * carry_n
             _, h = scan(carry, (xw, mexp))
         # Masked steps held state, so the last step's output is the state at

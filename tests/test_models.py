@@ -210,3 +210,91 @@ def test_lru_invalid_anchor_features_do_not_leak():
     x2 = x.at[:, -1].add(100.0)  # garbage in the masked anchor
     y1 = model.apply(params, x2, m)
     np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), atol=1e-5)
+
+
+# ---- factorized recurrences (PAPERS.md F-/G-LSTM tricks) ---------------
+
+def _n_params(params):
+    return sum(int(np.prod(a.shape)) for a in jax.tree.leaves(params))
+
+
+@pytest.mark.parametrize("kind", ["lstm", "gru"])
+@pytest.mark.parametrize("kw", [{"factor_rank": 8}, {"n_groups": 4}])
+@pytest.mark.fast
+def test_factorized_rnn_forward_and_params_shrink(kind, kw):
+    """F-LSTM (low-rank) and G-LSTM (grouped) variants: finite masked
+    forward, fewer params than dense, and finite grads."""
+    x, m = make_batch()
+    _, p_dense, _ = init_and_apply(kind, x, m, hidden=32)
+    model, params, y = init_and_apply(kind, x, m, hidden=32, **kw)
+    assert y.shape == (B,) and bool(jnp.isfinite(y).all())
+    assert _n_params(params) < _n_params(p_dense)
+
+    def loss(p):
+        return jnp.sum(model.apply(p, x, m) ** 2)
+
+    g = jax.grad(loss)(params)
+    assert all(bool(jnp.isfinite(a).all()) for a in jax.tree.leaves(g))
+
+
+@pytest.mark.parametrize("kw", [{"factor_rank": 8}, {"n_groups": 4}])
+@pytest.mark.fast
+def test_factorized_rnn_masking_holds_state(kw):
+    """The factorizations change only the projections — masked steps must
+    still hold the carried state exactly (same invariant as dense)."""
+    x, m = make_batch(all_valid=True)
+    model = build_model("lstm", hidden=32, **kw)
+    params = model.init(jax.random.key(0), x, m)
+    y_full = model.apply(params, x, m)
+    # Invalidate (and zero) a mid-window step: outputs must equal the
+    # same history with that month never observed.
+    m2 = np.asarray(m).copy()
+    m2[:, W // 2] = False
+    x2 = np.asarray(x).copy()
+    x2[:, W // 2] = 0.0
+    x3 = np.asarray(x).copy()
+    x3[:, W // 2] = 123.0  # garbage behind the mask must not matter
+    y_masked = model.apply(params, jnp.asarray(x2), jnp.asarray(m2))
+    y_garbage = model.apply(params, jnp.asarray(x3), jnp.asarray(m2))
+    np.testing.assert_allclose(np.asarray(y_masked), np.asarray(y_garbage),
+                               atol=1e-6)
+    assert not np.allclose(np.asarray(y_full), np.asarray(y_masked))
+
+
+@pytest.mark.fast
+def test_factorized_rnn_validation():
+    x, m = make_batch()
+    with pytest.raises(ValueError, match="alternative factorizations"):
+        init_and_apply("lstm", x, m, hidden=32, factor_rank=4, n_groups=2)
+    with pytest.raises(ValueError, match="divide evenly"):
+        init_and_apply("lstm", x, m, hidden=30, n_groups=4)
+    with pytest.raises(ValueError, match="scan_impl='xla'"):
+        init_and_apply("lstm", x, m, hidden=32, factor_rank=4,
+                       scan_impl="pallas")
+    with pytest.raises(ValueError, match="n_groups must be >= 1"):
+        init_and_apply("lstm", x, m, hidden=32, n_groups=0)
+    with pytest.raises(ValueError, match="factor_rank must be >= 1"):
+        init_and_apply("lstm", x, m, hidden=32, factor_rank=0)
+
+
+@pytest.mark.fast
+def test_factorized_auto_resolves_to_xla_scan():
+    """config.model_kwargs must route factorized models to the XLA scan
+    even where auto would pick the Pallas kernel."""
+    from unittest import mock
+
+    from lfm_quant_tpu.config import get_preset, model_kwargs
+    import dataclasses
+
+    cfg = get_preset("c2")
+    kw = dict(cfg.model.kwargs)
+    kw["n_groups"] = 4
+    cfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, kwargs=kw))
+    with mock.patch("jax.default_backend", return_value="tpu"):
+        kind, resolved = model_kwargs(cfg)
+    assert resolved["scan_impl"] == "xla"
+    # Dense c2 on the same (mocked) backend keeps the fused kernel.
+    with mock.patch("jax.default_backend", return_value="tpu"):
+        _, dense = model_kwargs(get_preset("c2"))
+    assert dense["scan_impl"] == "pallas_fused"

@@ -107,7 +107,6 @@ def test_walkforward_warm_start_carries_params(panel, tmp_path):
     assert [r["warm_started"] for r in summary_c["folds"]] == [False, False]
     np.testing.assert_array_equal(valid_w, valid_c)
     fold1_months = valid_w.copy()
-    lo = int(np.searchsorted(panel.dates, month_add(198001, 24)))
     hi = int(np.searchsorted(panel.dates, month_add(198001, 36)))
     fold1_months[:, :] = False
     fold1_months[:, hi:] = valid_w[:, hi:]  # fold 1's prediction window
