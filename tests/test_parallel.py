@@ -94,6 +94,35 @@ def test_dp_training_matches_single_device(panel, tmp_path):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_dp_training_grouped_lstm_matches_single_device(panel, tmp_path):
+    """The G-LSTM's einsum-based block-diagonal projections must be
+    GSPMD-clean: date-sharded training numerically equals single-device
+    (replicated params, batch-sharded einsum operand)."""
+    import dataclasses
+
+    splits = PanelSplits.by_date(panel, 197910, 198101)
+
+    def cfg(n_shards, sub):
+        c = _fit_cfg(panel, n_shards, tmp_path / sub)
+        return dataclasses.replace(
+            c, model=ModelConfig(kind="lstm",
+                                 kwargs={"hidden": 16, "n_groups": 4}))
+
+    t1 = Trainer(cfg(1, "a"), splits)
+    t8 = Trainer(cfg(8, "b"), splits)
+    assert t8.mesh is not None and t8.mesh.shape["data"] == 8
+    assert t8.model.n_groups == 4
+
+    s1, s8 = t1.init_state(), t8.init_state()
+    for b in t1.train_sampler.epoch(0):
+        s1, m1 = t1._jit_step(s1, t1.dev, *t1._batch_args(b, train=True))
+        s8, m8 = t8._jit_step(s8, t8.dev, *t8._batch_args(b, train=True))
+    assert float(m1["loss"]) == pytest.approx(float(m8["loss"]), rel=1e-4)
+    for l1, l8 in zip(jax.tree.leaves(s1.params), jax.tree.leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l8),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_dp_rank_ic_loss_shard_local(panel, tmp_path):
     """rank_ic ranks within months; sharding dates across devices must not
     change the loss value."""
