@@ -69,9 +69,43 @@ def main(argv=None) -> int:
                     help="names to print for the latest month")
     args = ap.parse_args(argv)
 
+    import glob
+    import json
+    import os
+
     from lfm_quant_tpu.data import anchor_index
     from lfm_quant_tpu.train.forecast import (is_ensemble_run_dir,
                                               load_forecaster, run_forecast)
+
+    # A walk-forward directory resolves to its LAST COMPLETED fold — the
+    # model trained on the most recent data, which is the one to trade
+    # live. (Detection must precede load_forecaster: the wf root carries
+    # a config.json of its own but no checkpoint.)
+    for progress in ("summary.json", "partial.json"):
+        path = os.path.join(args.run_dir, progress)
+        if not os.path.exists(path) or not glob.glob(
+                os.path.join(args.run_dir, "fold_*")):
+            continue
+        with open(path) as fh:
+            doc = json.load(fh)
+        records = doc["folds"] if isinstance(doc, dict) else doc
+        if not records:
+            raise SystemExit(f"{args.run_dir} is a walk-forward dir with "
+                             "no completed folds yet")
+        rec = records[-1]  # appended in fold order (resume validates it)
+        fold_dir = os.path.join(args.run_dir, f"fold_{rec['fold']}")
+        # Older runs predate per-fold config.json: the fold DIR exists
+        # (checkpoints were always written there) but is not loadable.
+        if not os.path.exists(os.path.join(fold_dir, "config.json")):
+            raise SystemExit(
+                f"walk-forward progress names fold {rec['fold']} but "
+                f"{fold_dir} has no config.json (older run predating "
+                "loadable fold dirs? re-run the walk-forward, or point "
+                "--run-dir at a single-model run dir directly)")
+        print(f"walk-forward dir: using fold {rec['fold']}'s model "
+              f"(trained through {rec['train_end']})")
+        args.run_dir = fold_dir
+        break
 
     if is_ensemble_run_dir(args.run_dir) and args.mc_samples > 0:
         # Validate BEFORE load_forecaster restores every seed checkpoint.

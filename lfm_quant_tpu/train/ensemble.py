@@ -422,8 +422,8 @@ def run_ensemble_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
     os.makedirs(run_dir, exist_ok=True)
     with open(os.path.join(run_dir, "config.json"), "w") as fh:
         fh.write(cfg.to_json())
-    with open(os.path.join(run_dir, "ensemble.flag"), "w") as fh:
-        fh.write("stacked-seed-axis checkpoint\n")
+    from lfm_quant_tpu.train.forecast import mark_ensemble_run_dir
+    mark_ensemble_run_dir(run_dir, True)
     with open(os.path.join(run_dir, "summary.json"), "w") as fh:
         json.dump({k: v for k, v in summary.items() if k != "history"}, fh,
                   indent=2, default=str)

@@ -23,6 +23,19 @@ def is_ensemble_run_dir(run_dir: str) -> bool:
     return os.path.exists(os.path.join(run_dir, "ensemble.flag"))
 
 
+def mark_ensemble_run_dir(run_dir: str, ensemble: bool) -> None:
+    """Write (or remove) the ensemble marker — the ONE writer for every
+    run-dir producer, so the flag is both created and CLEARED when a dir
+    is reused by the other trainer kind (a stale flag would route
+    load_forecaster to the wrong restore)."""
+    path = os.path.join(run_dir, "ensemble.flag")
+    if ensemble:
+        with open(path, "w") as fh:
+            fh.write("stacked-seed-axis checkpoint\n")
+    elif os.path.exists(path):
+        os.unlink(path)
+
+
 def load_forecaster(run_dir: str):
     """Load a run dir's trained model (single seed or ensemble —
     auto-detected via the ``ensemble.flag`` marker).

@@ -178,6 +178,25 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
         # Per-fold seed offset keeps fold models independent draws while
         # staying replayable.
         fold_cfg = dataclasses.replace(cfg, seed=cfg.seed + 1000 * k)
+        if run_dir:
+            # Make every fold dir a standalone loadable run dir
+            # (load_trainer/load_ensemble): config.json pins the FOLD's
+            # split boundaries so a reload reconstructs the exact
+            # training-time splits, and the ensemble marker routes
+            # load_forecaster. Written BEFORE fit so a crashed fold is
+            # still inspectable. forecast.py uses the LAST fold — the
+            # model trained on the most recent data — for live rankings.
+            from lfm_quant_tpu.train.forecast import mark_ensemble_run_dir
+
+            os.makedirs(run_dir, exist_ok=True)
+            save_cfg = dataclasses.replace(
+                fold_cfg, data=dataclasses.replace(
+                    fold_cfg.data, train_end=train_end, val_end=val_end))
+            with open(os.path.join(run_dir, "config.json"), "w") as fh:
+                fh.write(save_cfg.to_json())
+            # Also CLEARS a stale flag when a reused dir flips trainer
+            # kind between runs.
+            mark_ensemble_run_dir(run_dir, ensemble)
         trainer = (EnsembleTrainer if ensemble else Trainer)(
             fold_cfg, splits, run_dir=run_dir, echo=echo)
         used_warm = warm_start and prev_params is not None

@@ -117,6 +117,31 @@ def test_walkforward_warm_start_carries_params(panel, tmp_path):
     np.testing.assert_array_equal(fc_w[fold0_months], fc_c[fold0_months])
 
 
+def test_walkforward_fold_dirs_are_loadable_and_forecastable(panel, tmp_path):
+    """Every fold run dir must stand alone for load_trainer (config.json
+    pins the FOLD's split boundaries), and forecast.py must resolve the
+    wf ROOT to the last completed fold — the production live-trading
+    flow."""
+    import forecast as forecast_cli
+    from lfm_quant_tpu.train.loop import load_trainer
+
+    cfg = _cfg(tmp_path)
+    wf_dir = tmp_path / "wf"
+    run_walkforward(cfg, panel, start=198001, step_months=12, val_months=24,
+                    n_folds=2, out_dir=str(wf_dir))
+    # Fold 1's reload reconstructs the fold's exact split boundaries.
+    tr, splits = load_trainer(str(wf_dir / "fold_1"), panel=panel)
+    assert splits.train_end_idx == int(
+        np.searchsorted(panel.dates, month_add(198001, 12)))
+    assert splits.val_end_idx == int(
+        np.searchsorted(panel.dates, month_add(198001, 12 + 24)))
+    # The wf root resolves to fold_1 (the most recently trained model).
+    csv = tmp_path / "live.csv"
+    rc = forecast_cli.main(["--run-dir", str(wf_dir), "--csv", str(csv)])
+    assert rc == 0
+    assert len(csv.read_text().splitlines()) > 1
+
+
 def test_warm_start_fit_rejects_mismatched_params(panel, tmp_path):
     """A warm start across different model configs must fail loudly, not
     deep inside a jit trace."""
