@@ -1,7 +1,6 @@
 """Backtest engine tests: hand-computed portfolio math + planted-alpha
 recovery on the synthetic panel (SURVEY.md §4.3 parity)."""
 
-import dataclasses
 import json
 
 import numpy as np

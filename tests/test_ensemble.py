@@ -123,9 +123,6 @@ def test_ensemble_checkpoint_roundtrip_and_backtest(fitted, panel):
 def test_ensemble_beats_or_matches_worst_member(fitted):
     """The ensemble mean forecast should not be worse than the worst
     individual member on test IC (basic variance-reduction sanity)."""
-    from lfm_quant_tpu.ops import spearman_ic
-    import jax.numpy as jnp
-
     _, _, trainer, splits = fitted
     stacked, valid = trainer.predict("test")
     t = splits.panel

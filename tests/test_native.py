@@ -203,8 +203,6 @@ def test_native_sampler_deterministic_and_seed_sensitive(sampler_pair):
 
 def test_trainer_runs_with_native_sampler():
     """End-to-end: one tiny training epoch with sampler_engine='native'."""
-    import dataclasses
-
     from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
                                       RunConfig)
     from lfm_quant_tpu.data.panel import PanelSplits

@@ -3,7 +3,7 @@
 import numpy as np
 import pytest
 
-from lfm_quant_tpu.data import Panel, PanelSplits, load_panel, synthetic_panel
+from lfm_quant_tpu.data import PanelSplits, load_panel, synthetic_panel
 
 pytestmark = pytest.mark.fast  # whole module is smoke-lane cheap
 

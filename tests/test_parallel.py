@@ -10,9 +10,7 @@ import pytest
 from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
 from lfm_quant_tpu.data import PanelSplits, synthetic_panel
 from lfm_quant_tpu.parallel import (
-    batch_sharding,
     make_mesh,
-    replicated,
     seed_sharding,
     shard_batch,
 )
