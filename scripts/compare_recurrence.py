@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Accuracy leg of the flagship-recurrence decision (round-3 verdict
+item 5): LSTM vs the time-parallel LRU at the c2 window geometry, same
+data, same optimizer budget, multiple seeds. The throughput leg comes
+from the chip campaign's lru/lru64 rows; this script supplies the
+planted-signal accuracy comparison those rows must be weighed against,
+and persists each result to the measurement ledger (backend-tagged, so
+CPU rows never displace chip rows).
+
+Run: python scripts/compare_recurrence.py [--seeds 3] [--firms 500]
+     [--epochs 10]
+
+CPU-feasible by scaling the firm axis only — the window stays the full
+60 months (the axis the recurrences actually differ on).
+"""
+
+import argparse
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _backend_name, persist_row  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--firms", type=int, default=500)
+    ap.add_argument("--epochs", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from lfm_quant_tpu.config import get_preset
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.train import Trainer
+
+    # ONE panel for both models, built from c2's geometry — the invariant
+    # is structural, not a coincidence of preset configs staying equal.
+    ref = dataclasses.replace(get_preset("c2").data, n_firms=args.firms,
+                              n_months=240)
+    panel = synthetic_panel(n_firms=ref.n_firms, n_months=ref.n_months,
+                            n_features=ref.n_features, horizon=ref.horizon,
+                            seed=0)
+    splits = PanelSplits.by_date(panel, 198601, 198801)
+
+    results = {}
+    for preset in ("c2", "lru"):
+        base = get_preset(preset)
+        d = dataclasses.replace(base.data, n_firms=args.firms, n_months=240)
+        if (d.n_features, d.horizon, d.window) != (
+                ref.n_features, ref.horizon, ref.window):
+            raise SystemExit(
+                f"preset {preset} drifted from c2's data geometry — the "
+                "same-panel comparison no longer holds; re-align the "
+                "presets or generalize this script")
+        cfg = dataclasses.replace(
+            base, data=d,
+            optim=dataclasses.replace(base.optim, epochs=args.epochs))
+        ics = []
+        for s in range(args.seeds):
+            tr = Trainer(dataclasses.replace(cfg, seed=s), splits)
+            fit = tr.fit()
+            ics.append(fit["best_val_ic"])
+            print(f"[{preset} seed {s}] best_val_ic={ics[-1]:.4f} "
+                  f"({fit['epochs_run']} epochs)", flush=True)
+        results[preset] = ics
+        rec = {"metric": "recurrence_accuracy",
+               "config": cfg.name,  # full preset name: one config
+               # namespace with the throughput rows in the ledger
+               "value": round(float(np.mean(ics)), 4),
+               "std": round(float(np.std(ics)), 4),
+               "unit": "best_val_ic",
+               "n_seeds": args.seeds,
+               "firms": args.firms,
+               "epochs": args.epochs,
+               "backend": _backend_name()}
+        persist_row(rec)
+        print(rec, flush=True)
+
+    lstm, lru = np.mean(results["c2"]), np.mean(results["lru"])
+    print(f"SUMMARY: LSTM val IC {lstm:.4f} vs LRU {lru:.4f} "
+          f"(delta {lru - lstm:+.4f}) at firms={args.firms}, "
+          f"window=60, epochs={args.epochs}, seeds={args.seeds}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
