@@ -83,10 +83,14 @@ class OptimConfig:
     early_stop_patience: int = 5  # epochs without val improvement
     loss: str = "mse"  # mse | huber | rank_ic | nll
     # adamw | lamb. LAMB (layerwise-adaptive Adam; the large-batch-LSTM
-    # recipe, PAPERS.md "Large-Batch Training for LSTM and Beyond") holds
-    # accuracy when the effective batch grows with the data axis — on a
-    # pod, dates_per_batch × firms_per_date × n_data_shards can reach
-    # 10^5-10^6 firm rows per step, where plain AdamW needs lr re-tuning.
+    # recipe, PAPERS.md "Large-Batch Training for LSTM and Beyond") is
+    # the CONTINGENCY for pod-scale effective batches (dates_per_batch ×
+    # firms × n_data_shards reaching 10^5-10^6 firm rows/step, where
+    # plain AdamW is known to degrade). Measured at 8× batch
+    # (ledger `large_batch_optimizer` rows, 2026-07-31): linearly-scaled
+    # AdamW HOLDS accuracy (0.529 vs 0.528 reference val IC) and LAMB
+    # trails slightly (0.507) — keep adamw until the batch is large
+    # enough that it visibly breaks; don't switch preemptively.
     optimizer: str = "adamw"
 
 
