@@ -110,6 +110,12 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     fresh) — the early-stop BEST state when fold run dirs exist
     (``out_dir`` set: ``fit`` restores ckpt/best at finalize), the
     last-epoch state otherwise (no run dir → no best checkpoint line).
+    Measured at toy scale (ledger ``walkforward_warm_start`` rows,
+    2026-07-31: 4 folds × 2 seeds): NO epoch savings when fresh folds
+    already converge in ~4 epochs, but a small accuracy gain
+    (+0.008 mean fold val IC — the carry acts as extra training
+    signal). The wall-clock case is for production folds that need many
+    epochs; don't expect savings on quick-converging configs.
     No lookahead: fold k-1 trained on strictly earlier data than fold k's
     prediction window, so the out-of-sample property is intact — the carry
     only moves the fold's starting point closer to a solution, the
