@@ -40,6 +40,10 @@ class DataConfig:
     # file's first feature column.
     target_col: Optional[str] = None
     panel_seed: int = 0
+    # Synthetic-panel heteroscedasticity (data/panel.py synthetic_panel):
+    # 0.0 = the legacy homoscedastic generator; > 0 ties the target-noise
+    # scale to an observable feature — the uncertainty stack's testbed.
+    het_noise: float = 0.0
     # Epoch index sampling: "python" (numpy RNG), "native" (C++ sampler,
     # lfm_quant_tpu/native/), "auto" (native when built). The two engines
     # produce different-but-equally-valid deterministic orders.

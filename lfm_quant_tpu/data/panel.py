@@ -220,6 +220,7 @@ def synthetic_panel(
     horizon: int = 12,
     signal_strength: float = 0.6,
     noise: float = 0.5,
+    het_noise: float = 0.0,
     min_history: int = 72,
     seed: int = 0,
 ) -> Panel:
@@ -241,6 +242,16 @@ def synthetic_panel(
     * Ragged histories: each firm gets a random [first, last] live span of at
       least ``min_history`` months, with a small rate of missing months
       inside the span.
+    * ``het_noise > 0`` makes the target noise HETEROSCEDASTIC and
+      *learnable*: cell (i, t)'s noise scale is
+      ``noise · exp(het_noise · feats[i, t, -1])`` — driven by the LAST
+      feature, which sits in the model's own input window (anchor-last),
+      so an NLL head can and must recover the profile, and
+      ``mean_minus_total_std`` aggregation has real predicted-variance
+      differences to act on. (A latent per-firm scale independent of the
+      features would be unlearnable by construction — the first draft of
+      this testbed made exactly that mistake.) The default 0.0 keeps
+      every existing test's homoscedastic generator byte-identical.
     """
     if n_features < 2:
         raise ValueError("need >= 2 features for the planted interaction term")
@@ -277,9 +288,27 @@ def synthetic_panel(
     trend[:, 12:] = feats[:, 12:, 0] - feats[:, :-12, 0]
     signal = lin + inter + 0.5 * trend
 
-    targets = (signal + noise * rng.standard_normal((n_firms, n_months))).astype(
-        np.float32
-    )
+    if het_noise > 0.0:
+        # Noise scale driven by the OBSERVABLE last feature AT THE ANCHOR
+        # month (clipped so a tail draw can't explode the target range).
+        # Targets built at raw month τ are later shifted to anchor
+        # t = τ − horizon, so the driver must be indexed τ − horizon for
+        # the anchor's own window — in the model's input — to carry the
+        # noise information. No extra rng draw on either branch:
+        # het_noise=0.0 keeps the legacy RNG stream — and every seeded
+        # fixture — byte-identical.
+        driver = np.zeros((n_firms, n_months), np.float32)
+        if horizon < n_months:
+            driver[:, horizon:] = feats[:, :-horizon, -1]
+        cell_scale = np.exp(
+            het_noise * np.clip(driver, -2.5, 2.5)).astype(np.float32)
+    else:
+        # Plain python 1.0: a float32 scalar would demote a python-float
+        # `noise` under NEP 50 and break legacy byte-identity in the
+        # last ulp for noise values not representable in float32.
+        cell_scale = 1.0
+    targets = (signal + noise * cell_scale
+               * rng.standard_normal((n_firms, n_months))).astype(np.float32)
 
     # Forward 1-month returns: loaded on the *future* signal so that ranking
     # firms by a good forecast of `targets` earns positive forward returns.

@@ -949,6 +949,7 @@ def resolve_panel(d) -> Panel:
         panel = synthetic_panel(
             n_firms=d.n_firms, n_months=d.n_months, n_features=d.n_features,
             start_yyyymm=d.start_yyyymm, horizon=d.horizon, seed=d.panel_seed,
+            het_noise=d.het_noise,
         )
     if getattr(d, "derived_features", ()):
         from lfm_quant_tpu.data.features import add_derived_features

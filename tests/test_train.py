@@ -190,6 +190,42 @@ def test_forecast_cli_ranks_live_months(fitted, tmp_path, capsys):
     assert fcs == sorted(fcs, reverse=True)
 
 
+def test_nll_head_recovers_heteroscedastic_noise_profile(tmp_path):
+    """On a panel with KNOWN per-firm noise scales (het_noise=1.0), an
+    NLL-trained heteroscedastic head must rank firms by noisiness: its
+    predicted aleatoric std should correlate with each firm's realized
+    residual spread. This is the uncertainty stack's ground-truth test —
+    on the legacy homoscedastic generator the head has nothing to learn
+    and the correlation would be noise."""
+    from lfm_quant_tpu.ops.metrics import spearman_ic
+
+    het_panel = synthetic_panel(n_firms=300, n_months=160, n_features=5,
+                                seed=9, het_noise=1.0)
+    cfg = tiny_cfg(out_dir=str(tmp_path),
+                   optim=OptimConfig(lr=3e-3, epochs=8, warmup_steps=10,
+                                     early_stop_patience=8, loss="nll"),
+                   data=DataConfig(n_firms=300, n_months=160, n_features=5,
+                                   window=12, dates_per_batch=4,
+                                   firms_per_date=64, panel_seed=9,
+                                   het_noise=1.0))
+    # The config now fully DESCRIBES the panel: resolve_panel reproduces it.
+    from lfm_quant_tpu.train.loop import resolve_panel
+    np.testing.assert_array_equal(resolve_panel(cfg.data).targets,
+                                  het_panel.targets)
+    splits = PanelSplits.by_date(het_panel, 198001, 198201)
+    trainer = Trainer(cfg, splits)
+    trainer.fit()
+    fc, avar, valid = trainer.predict("val", return_variance=True)
+
+    pred_std = np.sqrt(np.where(valid, avar, np.nan))
+    resid = np.where(valid, het_panel.targets - fc, np.nan)
+    firm_has = np.isfinite(resid).sum(axis=1) >= 8
+    pred_i = np.nanmean(pred_std[firm_has], axis=1)
+    true_i = np.nanstd(resid[firm_has], axis=1)
+    rho = float(spearman_ic(pred_i, true_i, np.ones_like(pred_i)))
+    assert rho > 0.3, f"NLL head failed to rank firm noise: rho={rho:.3f}"
+
+
 def test_early_stopping_triggers(panel, tmp_path):
     cfg = tiny_cfg(
         optim=OptimConfig(lr=0.0, epochs=10, warmup_steps=0,
