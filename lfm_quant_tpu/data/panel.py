@@ -24,7 +24,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -221,6 +221,8 @@ def synthetic_panel(
     signal_strength: float = 0.6,
     noise: float = 0.5,
     het_noise: float = 0.0,
+    trend_weight: float = 0.5,
+    phi_range: Tuple[float, float] = (0.94, 0.995),
     min_history: int = 72,
     seed: int = 0,
 ) -> Panel:
@@ -240,9 +242,13 @@ def synthetic_panel(
       trend's usable content, and anchor-only, windowed-MLP, windowed-
       LSTM, and derived-``chg_12`` models all tie within ±0.01 val IC.
       The generator separates window models from anchor models only when
-      the trend weight is raised or persistence lowered; tests that need
-      that separation must set those knobs explicitly rather than rely
-      on the defaults.
+      the trend weight is raised or persistence lowered — the
+      ``trend_weight`` and ``phi_range`` parameters exist for exactly
+      that (measured: ``trend_weight=2.0, phi_range=(0.5, 0.7)`` gives a
+      windowed MLP +0.024 val IC over the anchor-only MLP at a 10-epoch
+      budget; the separation is real but stays modest at small budgets).
+      Tests that need it must set these knobs explicitly rather than
+      rely on the defaults.
     * Forward returns = next-month target innovation × ``signal_strength`` +
       idiosyncratic noise, so a correct forecast ranks next-month winners and
       the backtest shows positive IC/alpha on the planted signal.
@@ -276,7 +282,8 @@ def synthetic_panel(
     # Fundamentals are sticky: high AR(1) persistence + sizeable firm fixed
     # effects make the 12-month-ahead target genuinely forecastable, which the
     # signal-recovery tests rely on.
-    phi = rng.uniform(0.94, 0.995, size=(1, 1, n_features)).astype(np.float32)
+    phi = rng.uniform(phi_range[0], phi_range[1],
+                      size=(1, 1, n_features)).astype(np.float32)
     firm_mean = (0.6 * rng.standard_normal((n_firms, 1, n_features))).astype(np.float32)
     innov_scale = np.sqrt(1.0 - phi**2).astype(np.float32)  # unit stationary var
     feats = np.empty((n_firms, n_months, n_features), dtype=np.float32)
@@ -293,7 +300,7 @@ def synthetic_panel(
     inter = 0.4 * feats[..., 0] * feats[..., 1]
     trend = np.zeros((n_firms, n_months), dtype=np.float32)
     trend[:, 12:] = feats[:, 12:, 0] - feats[:, :-12, 0]
-    signal = lin + inter + 0.5 * trend
+    signal = lin + inter + trend_weight * trend
 
     if het_noise > 0.0:
         # Noise scale driven by the OBSERVABLE last feature AT THE ANCHOR
