@@ -53,3 +53,27 @@ def spearman_ic(pred, target, w):
     pr = _hard_ranks(pred, w)
     tr = _hard_ranks(target, w)
     return _masked_pearson(pr, tr, w)
+
+
+def noise_recovery_rho(targets, forecast, unc_std, valid, min_months: int = 8):
+    """Per-firm noise-profile recovery: Spearman ρ between a model's
+    predicted uncertainty and each firm's realized residual spread.
+
+    The het-testbed diagnostic (``synthetic_panel(het_noise>0)``): an
+    aleatoric estimator that works must rank firms by noisiness. ONE
+    implementation shared by the CI gate
+    (tests/test_train.py noise-profile test) and the evidence-ledger
+    reproducer (scripts/evidence_probes.py mcdropout) — the protocol
+    (residual definition, ``min_months`` firm filter, rank statistic)
+    must never diverge between them.
+
+    Args are full-panel-shaped [N, T] numpy arrays (``forecast``/
+    ``unc_std`` as returned by ``Trainer.predict``); returns a float.
+    """
+    import numpy as np
+
+    resid = np.where(valid, targets - forecast, np.nan)
+    has = np.isfinite(resid).sum(axis=1) >= min_months
+    pred_i = np.nanmean(np.where(valid, unc_std, np.nan)[has], axis=1)
+    true_i = np.nanstd(resid[has], axis=1)
+    return float(spearman_ic(pred_i, true_i, np.ones_like(pred_i)))

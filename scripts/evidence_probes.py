@@ -18,6 +18,9 @@ the tree — these are the exact protocols behind the 2026-07-31 rows:
   derived      → `derived_features` rows: anchor-only MLP vs windowed
                  MLP/LSTM vs anchor MLP + chg_12 — the generator
                  separation calibration.
+  mcdropout    → `noise_profile_recovery` rows: NLL head vs MC-dropout
+                 std at recovering the planted noise profile — the
+                 estimator division-of-labor measurement.
 
 Run: python scripts/evidence_probes.py <probe> [seeds]
 Rows append to the ledger (LFM_BENCH_ROWS overrides the path); point it
@@ -214,8 +217,65 @@ def probe_derived(seeds=(0, 1)):
         print(rec, flush=True)
 
 
+def probe_mcdropout(seeds=(0,)):
+    """NLL head vs MC-dropout std at recovering the planted noise
+    profile on the het testbed — per-firm Spearman ρ of predicted
+    uncertainty vs realized residual spread (seeds average the ρs)."""
+    import numpy as np
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import PanelSplits, synthetic_panel
+    from lfm_quant_tpu.ops.metrics import noise_recovery_rho
+    from lfm_quant_tpu.train import Trainer
+
+    panel = synthetic_panel(n_firms=300, n_months=160, n_features=5, seed=9,
+                            het_noise=1.0)
+    splits = PanelSplits.by_date(panel, 198001, 198201)
+    data = DataConfig(n_firms=300, n_months=160, n_features=5, window=12,
+                      dates_per_batch=4, firms_per_date=64, panel_seed=9,
+                      het_noise=1.0)
+
+    def firm_corr(unc_std, fc, valid):
+        # ONE protocol with the CI gate: lfm_quant_tpu.ops.metrics.
+        return noise_recovery_rho(panel.targets, fc, unc_std, valid)
+
+    rhos = {"nll_head": [], "mc_dropout": []}
+    for seed in seeds:
+        cfg = RunConfig(
+            name="mcd_nll", data=data,
+            model=ModelConfig(kind="mlp", kwargs={"hidden": (32,)}),
+            optim=OptimConfig(lr=3e-3, epochs=8, warmup_steps=10,
+                              early_stop_patience=8, loss="nll"), seed=seed)
+        tr = Trainer(cfg, splits)
+        tr.fit()
+        fc, avar, valid = tr.predict("val", return_variance=True)
+        rhos["nll_head"].append(firm_corr(np.sqrt(avar), fc, valid))
+
+        cfg = RunConfig(
+            name="mcd_drop", data=data,
+            model=ModelConfig(kind="mlp",
+                              kwargs={"hidden": (32,), "dropout": 0.2}),
+            optim=OptimConfig(lr=3e-3, epochs=8, warmup_steps=10,
+                              early_stop_patience=8, loss="mse"), seed=seed)
+        tr = Trainer(cfg, splits)
+        tr.fit()
+        stacked, valid = tr.predict("val", mc_samples=16)
+        rhos["mc_dropout"].append(
+            firm_corr(stacked.std(axis=0), stacked.mean(axis=0), valid))
+    for tag, vals in rhos.items():
+        mean, std = _mean_std(vals)
+        rec = {"metric": "noise_profile_recovery", "config": tag,
+               "value": mean, "std": std,
+               "unit": "spearman_rho_vs_realized",
+               "het_noise": 1.0, "n_seeds": len(seeds), "backend": "cpu"}
+        persist_row(rec)
+        print(rec, flush=True)
+
+
 PROBES = {"lamb": probe_lamb, "warmstart": probe_warmstart,
-          "uncertainty": probe_uncertainty, "derived": probe_derived}
+          "uncertainty": probe_uncertainty, "derived": probe_derived,
+          "mcdropout": probe_mcdropout}
 
 
 def main(argv) -> int:

@@ -197,7 +197,7 @@ def test_nll_head_recovers_heteroscedastic_noise_profile(tmp_path):
     residual spread. This is the uncertainty stack's ground-truth test —
     on the legacy homoscedastic generator the head has nothing to learn
     and the correlation would be noise."""
-    from lfm_quant_tpu.ops.metrics import spearman_ic
+    from lfm_quant_tpu.ops.metrics import noise_recovery_rho
 
     het_panel = synthetic_panel(n_firms=300, n_months=160, n_features=5,
                                 seed=9, het_noise=1.0)
@@ -216,13 +216,7 @@ def test_nll_head_recovers_heteroscedastic_noise_profile(tmp_path):
     trainer = Trainer(cfg, splits)
     trainer.fit()
     fc, avar, valid = trainer.predict("val", return_variance=True)
-
-    pred_std = np.sqrt(np.where(valid, avar, np.nan))
-    resid = np.where(valid, het_panel.targets - fc, np.nan)
-    firm_has = np.isfinite(resid).sum(axis=1) >= 8
-    pred_i = np.nanmean(pred_std[firm_has], axis=1)
-    true_i = np.nanstd(resid[firm_has], axis=1)
-    rho = float(spearman_ic(pred_i, true_i, np.ones_like(pred_i)))
+    rho = noise_recovery_rho(het_panel.targets, fc, np.sqrt(avar), valid)
     assert rho > 0.3, f"NLL head failed to rank firm noise: rho={rho:.3f}"
 
 
