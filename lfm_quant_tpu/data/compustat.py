@@ -212,7 +212,7 @@ def load_compustat_csv(
       engine: "auto" (native C++ parser for .csv when built, else pandas),
         "native", or "pandas". On well-formed numeric files (including
         RFC-4180 quoted fields) the engines produce identical panels; the
-        native one (lfm_quant_tpu/native/) parses ~2× faster than the
+        native one (lfm_quant_tpu/native/) parses ~1.8× faster than the
         pandas C parser (measured at c5 scale — 418 MB / 5.3M rows:
         parse-only 2.0–2.1 s vs 3.8–4.9 s, end-to-end load 6.2 s vs
         8.0 s; `scripts/dress_rehearsal.py` reproduces the artifact). One

@@ -246,6 +246,14 @@ class RNNModel(nn.Module):
     # Batch rows per Pallas grid block (None = rnn_scan's default); the
     # tuning knob scripts/sweep_rnn_blocks.py measures.
     scan_block_b: Optional[int] = None
+    # Eval-only override of scan_block_b (None = use scan_block_b). The
+    # deterministic forward has no backward pass, so its VMEM budget per
+    # block is ~3× lighter — it can ride wider blocks than training can
+    # afford, and the eval sweep is exactly the per-step-overhead-bound
+    # shape wider blocks help (eval MFU ≈ train/3 at equal bb, ledger
+    # 2026-07-31 c2 rows; DESIGN.md §9). Selected on `deterministic`,
+    # which is already a static jit argument — no extra recompiles.
+    eval_scan_block_b: Optional[int] = None
     # PAPERS.md factorization tricks (mutually exclusive; XLA scan only —
     # the Pallas kernels' VMEM/MXU layout assumes dense [H, G·H] weights):
     # factor_rank → low-rank U·V projections (F-LSTM); n_groups → block-
@@ -279,6 +287,8 @@ class RNNModel(nn.Module):
                 "auto-resolution routes factorized models to the XLA "
                 "scan; don't force a pallas impl on one)")
         compute_dtype = self.dtype or jnp.float32
+        block_b = (self.eval_scan_block_b or self.scan_block_b
+                   if deterministic else self.scan_block_b)
         batch_shape = x.shape[:-2]
         h = nn.Dense(self.hidden, dtype=self.dtype, name="embed")(
             x.astype(compute_dtype)
@@ -309,7 +319,7 @@ class RNNModel(nn.Module):
                     xb.astype(compute_dtype),
                     wh,
                     m.reshape((-1, W)),
-                    block_b=self.scan_block_b,
+                    block_b=block_b,
                 ).reshape(h.shape[:-1] + (self.hidden,))
                 continue
             # Hoisted input projection: all T steps in one GEMM — in the
@@ -331,7 +341,7 @@ class RNNModel(nn.Module):
                     xw.reshape((-1, W, xw.shape[-1])),
                     wh,
                     m.reshape((-1, W)),
-                    block_b=self.scan_block_b,
+                    block_b=block_b,
                 ).reshape(xw.shape[:-1] + (self.hidden,))
                 continue
             scan = nn.scan(

@@ -6,7 +6,7 @@
 // *host* runtime: the two host-side hot loops that feed it —
 //
 //   1. parse_rows(): long-format fundamentals CSV → dense row arrays.
-//      Replaces pandas' read_csv on the ingest path (~2× faster,
+//      Replaces pandas' read_csv on the ingest path (~1.8× faster,
 //      measured single-core, via the fast-path float parser below); the
 //      statistical preprocessing (winsorize/z-score) stays in vectorized
 //      numpy where it is already memory-bound.

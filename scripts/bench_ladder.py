@@ -31,6 +31,7 @@ from bench import (  # noqa: E402
     measure_ensemble_trainer,
     measure_eval,
     measure_trainer,
+    measure_with_spread,
     persist_row,
 )
 
@@ -167,15 +168,15 @@ def bench_config(name: str):
         _log(f"{name}: building EnsembleTrainer ({n_seeds} seeds)")
         trainer = EnsembleTrainer(cfg, splits)
         _log(f"{name}: measuring train (compile on first dispatch)")
-        value = measure_ensemble_trainer(
-            trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10")))
+        value, spread = measure_with_spread(lambda: measure_ensemble_trainer(
+            trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10"))))
     else:
         _log(f"{name}: building Trainer")
         trainer = Trainer(cfg, splits)
         _log(f"{name}: gather={trainer._gather_impl}; measuring train "
              "(compile on first dispatch)")
-        value = measure_trainer(
-            trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "30")))
+        value, spread = measure_with_spread(lambda: measure_trainer(
+            trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "30"))))
     # The RESOLVED impls (auto → xla|pallas|pallas_fused happened at
     # build time) and the backend, per row: a ledger row must say which
     # program ran where — A/B rows differ only by these fields, and a CPU
@@ -195,9 +196,11 @@ def bench_config(name: str):
         "config": cfg.name,
         "loss": cfg.optim.loss,
         **extras,
+        **spread,
     }
     _log(f"{name}: measuring eval sweep")
-    eval_value = measure_eval(trainer)
+    eval_value, eval_spread = measure_with_spread(
+        lambda: measure_eval(trainer))
     _log(f"{name}: done")
     # The EVAL dispatch's own gather (promotion flag included) — not the
     # train gather: the A/B rows the promotion flag exists for must get
@@ -221,6 +224,7 @@ def bench_config(name: str):
         "config": cfg.name,
         "eval_path": eval_path(trainer),
         **eval_extras,
+        **eval_spread,
     }
 
 

@@ -1,11 +1,20 @@
 #!/bin/bash
 # On-chip measurement campaign — fills BASELINE.md's pending ladder rows
-# after a tunnel outage (see BASELINE.md's 2026-07-30 note). Ordered so a
-# re-wedge loses the least: driver metrics first, then the unmeasured
-# ladder rows (each now also records an eval_throughput row), the 64-seed
-# HBM-fit probe, the block-size sweep, and the known wedge triggers LAST
-# (the first pass on 2026-07-31 proved c3-fullD's timeout-kill wedges the
-# tunnel; everything after it in the old order was lost to the abort).
+# after a tunnel outage (see BASELINE.md's 2026-07-30 note).
+#
+# ORDERING PRINCIPLE (round-4 verdict, Weak #4): steps are ranked by
+# banked-value-per-wedge-risk — the expected evidence value of the row
+# divided by its odds of wedging the tunnel and costing every later step.
+# Concretely: (1) proven-geometry headline re-measures (lowest risk,
+# error-bar value) first; (2) never-measured PRODUCTION ladder rows next
+# (moderate risk — first compiles — but each is a BASELINE.json config a
+# user would run); (3) boundary probes (64-seed HBM fit, block sweep)
+# behind those; (4) diagnostics and SYNTHETIC extras (c3-fullD — a
+# geometry no production config uses) DEAD LAST behind one-shot attempt
+# markers, because their timeout-kill is the one proven wedge trigger
+# (first pass 2026-07-31: c3-fullD rc=124 wedged the tunnel and lost
+# every remaining row). New steps must be slotted by this rule, not
+# appended.
 #
 # RESUMABLE: every measuring step is guarded by scripts/ledger_has.py —
 # a row already banked in BENCH_ROWS.jsonl skips its step, so the
@@ -55,10 +64,23 @@ print('TUNNEL_OK', float(jax.jit(lambda a: a@a)(jnp.ones((256,256), jnp.bfloat16
 
 probe start
 
-# Driver metrics first: c2 + c5@16 re-verified with the fused kernel.
+# Driver metrics first: c2 + c5@16 with the ERROR-BAR protocol (round-4
+# verdict ask 2: every absolute number becomes a median of >=3 reps with
+# a recorded spread — bench.py's measure_with_spread does this by
+# default now, tagging rows n_reps/spread_pct/rep_values). The `--has
+# n_reps` guard deliberately ignores the spreadless 2026-07-31 rows so
+# one re-measure banks spread-carrying replacements within a single
+# healthy window — presence, not equality, so an operator's
+# LFM_BENCH_OUTER_REPS choice still satisfies the resume guard.
 # (probe-start just ran — skip bench.py's own self-probe.)
-have metric=train_throughput_c2_lstm && have metric=train_throughput_c5_ensemble ||
-TMO=600 step bench env LFM_BENCH_SKIP_PROBE=1 python bench.py
+have metric=train_throughput_c2_lstm --has n_reps && have metric=train_throughput_c5_ensemble --has n_reps ||
+TMO=900 step bench env LFM_BENCH_SKIP_PROBE=1 python bench.py
+# Same-window cross-harness drift pair (the 55.4M-vs-41.7M discrepancy):
+# bench.py just measured c2; the ladder harness re-measures the same
+# geometry minutes later with its own spread. Two medians + two spreads
+# in one window either close the gap to <10% or pin it on the harness.
+have metric=train_throughput_c2 gather_impl=pallas --has n_reps ||
+TMO=900 step drift-c2 python scripts/bench_ladder.py c2
 
 # Unmeasured ladder rows (train + eval records each). c3 now trains
 # full-universe rank-IC (Bf ≈ 8192) — watch HBM; c2's eval row rides on
@@ -68,10 +90,13 @@ TMO=600 step bench env LFM_BENCH_SKIP_PROBE=1 python bench.py
 # eval rows measure the same program (they differ only in panel layout,
 # tagged lane_pad) and the guards key on the train rows — the only
 # artifact that distinguishes the legs.
-have metric=train_throughput_c2 gather_impl=pallas ||
-TMO=600 step ladder-c2 python scripts/bench_ladder.py c2
-have metric=train_throughput_c2 gather_impl=xla ||
-TMO=600 step ladder-c2-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c2
+# (No plain ladder-c2 step: drift-c2 above runs the identical command
+# under a strictly stronger guard.) Spread guard on the xla leg too: the
+# banked 2026-07-31 xla leg is spreadless; one re-run makes the c2
+# train-gather A/B a spread-vs-spread comparison in the same window as
+# drift-c2's pallas leg.
+have metric=train_throughput_c2 gather_impl=xla --has n_reps ||
+TMO=900 step ladder-c2-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c2
 # c3 at the REAL per-shard batch (8-way date sharding → D=1 per chip);
 # the full-D single-chip variant is a risky extra at the very END — its
 # timeout-kill is the one PROVEN tunnel-wedge trigger (first-pass log
@@ -79,11 +104,11 @@ TMO=600 step ladder-c2-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/be
 have metric=eval_throughput_c3 dates_per_batch=1 ||
 TMO=900 step ladder-c3 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c3
 have metric=eval_throughput_c4 ||
-TMO=600 step ladder-c4 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c4
+TMO=900 step ladder-c4 env LFM_BENCH_DATES=1 python scripts/bench_ladder.py c4
 have metric=eval_throughput_lru ||
-TMO=600 step ladder-lru python scripts/bench_ladder.py lru
+TMO=900 step ladder-lru python scripts/bench_ladder.py lru
 have metric=eval_throughput_c5 n_seeds=16 ||
-TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
+TMO=1200 step ladder-c5 python scripts/bench_ladder.py c5
 # Train-gather A/B at the FLAGSHIP geometry: the c2 A/B favored the XLA
 # gather for train too (+6%), but the auto default only flips once the
 # ensemble geometry (per-seed gathers) confirms it. Guard keys on the
@@ -91,18 +116,18 @@ TMO=900 step ladder-c5 python scripts/bench_ladder.py c5
 # in the ledger under distinct lane_pad tags (padded panel for the
 # pallas-train leg, un-padded for the xla leg).
 have metric=train_throughput_c5 n_seeds=16 gather_impl=xla ||
-TMO=900 step ladder-c5-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c5
+TMO=1200 step ladder-c5-xlagather env LFM_BENCH_GATHER_IMPL=xla python scripts/bench_ladder.py c5
 # LRU at the c5 ensemble geometry (16 seeds, same as c5's default) —
 # the flagship-recurrence decision row.
 have metric=eval_throughput_lru64 ||
-TMO=900 step ladder-lru64 python scripts/bench_ladder.py lru64
+TMO=1200 step ladder-lru64 python scripts/bench_ladder.py lru64
 # Long-context row: 240-month-window transformer (n_seq_shards degrades
 # to the 1 visible chip — full-window attention at window 240). First
 # on-chip run of this geometry → risky (OOM must not abort the session).
 # TMO=1800: a long-but-progressing first compile must not be timeout-
 # killed at 900 s — the kill, not the wait, is what wedges the tunnel.
 have metric=eval_throughput_lc ||
-TMO=1800 step ladder-lc python scripts/bench_ladder.py lc
+TMO=2400 step ladder-lc python scripts/bench_ladder.py lc
 probe after-lc
 
 # The 64-seed axis at 64 on one chip (BASELINE.json:11). First a
@@ -117,19 +142,22 @@ if ! have metric=eval_throughput_c5 n_seeds=64 seed_block=None; then
   probe after-hbmprobe
   TMO=600 step seeds64-hbmprobe-blocked python scripts/hbm_probe.py c5 --seeds 64 --seed-block 16
   probe after-hbmprobe-blocked
-  TMO=900 step seeds64-full env LFM_BENCH_SEEDS=64 python scripts/bench_ladder.py c5
+  TMO=1200 step seeds64-full env LFM_BENCH_SEEDS=64 python scripts/bench_ladder.py c5
   probe after-seeds64
 fi
 have metric=eval_throughput_c5 n_seeds=64 seed_block=16 ||
-TMO=900 step seeds64-blocked env LFM_BENCH_SEEDS=64 LFM_BENCH_SEED_BLOCK=16 \
+TMO=1200 step seeds64-blocked env LFM_BENCH_SEEDS=64 LFM_BENCH_SEED_BLOCK=16 \
   python scripts/bench_ladder.py c5
 probe after-seeds64b
 
-# Block-size sweep for the fused recurrence (DESIGN.md §8's bb lever).
-# Points persist individually; 5 banked points (default,256,512,1024,
-# 2048) mean the curve is complete.
-have metric=sweep_c2_block_b --distinct block_b --min-count 5 ||
-TMO=900 step sweep-blocks python scripts/sweep_rnn_blocks.py
+# Block-size sweep for the fused recurrence (DESIGN.md §8's bb lever),
+# now BOTH halves per point: train (5 points: default,256,512,1024,2048)
+# and the fwd-only eval sweep (6 points — 4096 extra, affordable without
+# the backward's VMEM budget; round-4 verdict ask 7's eval lever).
+# Points persist individually; the guard needs both curves complete.
+have metric=sweep_c2_block_b --distinct block_b --min-count 5 &&
+have metric=sweep_c2_eval_block_b --distinct block_b --min-count 6 ||
+TMO=1200 step sweep-blocks python scripts/sweep_rnn_blocks.py
 probe after-sweep
 
 # The c1 suspect, isolated (see scripts/diag_c1.py): first the
@@ -166,7 +194,7 @@ if ! have metric=diag_c1 impl=xla && ! have metric=diag_c1_attempt impl=xla; the
   probe after-c1diag-xla
 fi
 have metric=eval_throughput_c1 ||
-TMO=600 step c1 python scripts/bench_ladder.py c1
+TMO=900 step c1 python scripts/bench_ladder.py c1
 if ! have metric=diag_c1 impl=pallas && ! have metric=diag_c1_attempt impl=pallas; then
   mark diag_c1_attempt pallas
   TMO=420 step c1diag-pallas python scripts/diag_c1.py pallas 5

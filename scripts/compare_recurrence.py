@@ -21,6 +21,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# CPU-evidence script: force the CPU platform before any backend use (the
+# axon plugin ignores JAX_PLATFORMS env; a wedged tunnel hangs the claim).
+# LFM_PROBE_BACKEND=tpu opts back into the chip.
+if os.environ.get("LFM_PROBE_BACKEND", "cpu") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from bench import _backend_name, persist_row  # noqa: E402
 
 

@@ -32,6 +32,14 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# CPU-feasible rehearsal: force the CPU platform before any backend use
+# (the axon plugin ignores JAX_PLATFORMS env; a wedged tunnel hangs the
+# claim). LFM_PROBE_BACKEND=tpu opts back into the chip.
+if os.environ.get("LFM_PROBE_BACKEND", "cpu") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 
 def _log(msg):
     print(f"[dress] {msg}", file=sys.stderr, flush=True)

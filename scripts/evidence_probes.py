@@ -32,6 +32,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# These probes ARE the backend=cpu evidence class — force the CPU platform
+# before any backend use. The axon PJRT plugin ignores JAX_PLATFORMS env,
+# and on a wedged tunnel the default backend claim HANGS the whole probe
+# (observed 2026-07-31: a probe slept at claim for minutes with 2 s of CPU
+# time). LFM_PROBE_BACKEND=tpu deliberately opts back into the chip.
+if os.environ.get("LFM_PROBE_BACKEND", "cpu") == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from bench import persist_row  # noqa: E402
 
 
@@ -273,9 +283,97 @@ def probe_mcdropout(seeds=(0,)):
         print(rec, flush=True)
 
 
+def probe_native(seeds=(0, 1, 2)):
+    """The two C++ host-runtime claims (README "native" row): CSV parse
+    vs pandas' C parser and epoch index sampling vs the numpy sampler.
+    ``seeds`` doubles as the rep count — each value is the median of
+    len(seeds) interleaved reps so one host-scheduler hiccup can't mint
+    a speedup claim."""
+    import shutil
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from lfm_quant_tpu import native
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.data.compustat import (_parse_native, _parse_pandas,
+                                              to_long_frame)
+    from lfm_quant_tpu.data.windows import DateBatchSampler
+
+    if not native.available():
+        print("native library unavailable — nothing to measure",
+              file=sys.stderr)
+        return
+
+    reps = max(3, len(seeds))
+    panel = synthetic_panel(n_firms=2000, n_months=240, n_features=16,
+                            seed=0)
+    work = tempfile.mkdtemp(prefix="native_probe_")
+    csv_path = os.path.join(work, "panel.csv")
+    to_long_frame(panel).to_csv(csv_path, index=False)
+
+    def _ratio_rec(config, unit, slow, fast, extras):
+        # Per-rep ratios (interleaved, so drift hits both engines within
+        # a rep): median + spread_pct in the exact shape
+        # regen_baseline's error-bar renderer consumes.
+        ratios = sorted(s / f for s, f in zip(slow, fast))
+        med = float(np.median(ratios))
+        rec = {"metric": "native_host_runtime", "config": config,
+               "value": round(med, 2), "unit": unit, "n_reps": len(ratios),
+               "spread_pct": round(
+                   100.0 * (ratios[-1] - ratios[0]) / med, 1),
+               "rep_values": [round(r, 2) for r in ratios],
+               **extras, "backend": "cpu"}
+        persist_row(rec)
+        print(rec, flush=True)
+
+    try:
+        times = {"native": [], "pandas": []}
+        for _ in range(reps):  # interleaved: drift hits both engines
+            t0 = time.perf_counter()
+            _parse_native(csv_path, None)
+            times["native"].append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            _parse_pandas(csv_path, None)
+            times["pandas"].append(time.perf_counter() - t0)
+        _ratio_rec("csv_parse", "speedup_vs_pandas",
+                   times["pandas"], times["native"],
+                   {"native_s": round(float(np.median(times["native"])), 3),
+                    "pandas_s": round(float(np.median(times["pandas"])), 3)})
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    times = {"native": [], "python": []}
+    n_epochs = 8  # amortize the one-time eligibility/CSR setup
+
+    def one_rep(engine):
+        sampler = DateBatchSampler(panel, 12, 8, 256, seed=1, engine=engine)
+        sampler.stacked_epoch(0)  # warm: build + first-epoch caches
+        t0 = time.perf_counter()
+        for ep in range(1, n_epochs + 1):
+            sampler.stacked_epoch(ep)
+        return (time.perf_counter() - t0) / n_epochs
+
+    for engine in ("native", "python"):
+        one_rep(engine)  # DISCARDED process-level warmup: the .so
+        # load/bind and allocator/cache warm costs land here, not on rep
+        # 1's ledgered timing (the first measured rep read 0.48-0.75x —
+        # native "slower" than numpy — before this existed)
+    for _ in range(reps):
+        for engine in ("native", "python"):
+            times[engine].append(one_rep(engine))
+    _ratio_rec("epoch_sampling", "speedup_vs_numpy",
+               times["python"], times["native"],
+               {"native_ms": round(float(np.median(times["native"])) * 1e3,
+                                   2),
+                "python_ms": round(float(np.median(times["python"])) * 1e3,
+                                   2)})
+
+
 PROBES = {"lamb": probe_lamb, "warmstart": probe_warmstart,
           "uncertainty": probe_uncertainty, "derived": probe_derived,
-          "mcdropout": probe_mcdropout}
+          "mcdropout": probe_mcdropout, "native": probe_native}
 
 
 def main(argv) -> int:

@@ -7,11 +7,15 @@ campaign on recovery; these guards turn that re-fire into a resume, so each
 heal-cycle only spends chip time on rows the ledger does not yet hold.
 
 Usage: python scripts/ledger_has.py metric=eval_throughput_c3 \
-           dates_per_batch=1 [--min-count N] [--distinct KEY]
+           dates_per_batch=1 [--min-count N] [--distinct KEY] [--has KEY]
 
 Values compare as strings against str(row[key]); a key absent from the row
 compares as the string "None" (mirrors regen_baseline's key normalization,
 so `dates_per_batch=None` matches rows that never recorded the field).
+--has KEY requires the field to be PRESENT with any value — the guard
+shape for "a spread-carrying row exists" (n_reps varies with
+LFM_BENCH_OUTER_REPS, so an equality filter would re-burn chip time
+whenever the operator picked a different rep count).
 --distinct KEY counts DISTINCT values of KEY among matching rows instead of
 raw rows — a resumed sweep re-banks earlier points, so a raw count would
 satisfy the guard with duplicates of an incomplete curve. Rows with
@@ -29,7 +33,7 @@ from regen_baseline import ledger_path, load_rows  # noqa: E402
 
 def main(argv) -> int:
     min_count, distinct_key = 1, None
-    filters = {}
+    filters, has_keys = {}, []
     args = list(argv)
     while "--min-count" in args:
         i = args.index("--min-count")
@@ -39,12 +43,17 @@ def main(argv) -> int:
         i = args.index("--distinct")
         distinct_key = args[i + 1]
         del args[i:i + 2]
+    while "--has" in args:
+        i = args.index("--has")
+        has_keys.append(args[i + 1])
+        del args[i:i + 2]
     for a in args:
         k, _, v = a.partition("=")
         filters[k] = v
     hits = [row for row in load_rows(ledger_path())
             if row.get("unit") != "status" and row.get("backend") == "tpu"
-            and all(str(row.get(k, None)) == v for k, v in filters.items())]
+            and all(str(row.get(k, None)) == v for k, v in filters.items())
+            and all(k in row for k in has_keys)]
     n = (len({str(r.get(distinct_key, None)) for r in hits}) if distinct_key
          else len(hits))
     return 0 if n >= min_count else 1

@@ -56,7 +56,7 @@ def load_rows(path):
 
 def render_table(rows):
     """Collapse the ledger to latest-per-key and render markdown."""
-    measured, status = {}, []
+    measured, history, status = {}, {}, []
     for row in rows:  # file order == chronological; later rows override
         if row.get("unit") == "status":
             status.append(row)
@@ -67,6 +67,8 @@ def render_table(rows):
         key = (row.get("metric"),) + tuple(
             (k, row.get(k)) for k in KEY_FIELDS)
         measured[key] = row
+        if isinstance(row.get("value"), (int, float)):
+            history.setdefault(key, []).append(float(row["value"]))
 
     lines = [
         "| Metric | Value | Unit | MFU % | Geometry | Backend | When |",
@@ -82,6 +84,22 @@ def render_table(rows):
             # magnitudes (ICs, ratios) need their decimals kept.
             val = (f"**{val:,.1f}**" if abs(val) >= 10
                    else f"**{val:.4g}**")
+            # Error bars (round-4 verdict, Weak #1): the row's own
+            # median-of-reps spread if it has one, else (for an std-
+            # carrying evidence row) its cross-seed std, else — when the
+            # ledger holds repeat captures of the same key — the
+            # cross-session spread of the historical values.
+            if r.get("spread_pct") is not None:
+                val += f" ± {r['spread_pct']}% (n={r.get('n_reps', '?')})"
+            elif r.get("std"):
+                val += f" ± {r['std']:.4g}"
+            else:
+                hist = history.get(key, [])
+                if len(hist) >= 2 and min(hist) > 0:
+                    drift = 100.0 * (max(hist) - min(hist)) / min(hist)
+                    if drift >= 1.0:
+                        val += (f" (history n={len(hist)}: "
+                                f"{drift:.0f}% session drift)")
         else:
             val = "—"
         lines.append(

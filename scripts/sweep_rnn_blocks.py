@@ -1,8 +1,17 @@
 #!/usr/bin/env python
-"""Sweep the Pallas RNN kernel's batch block size at the config-2 train
+"""Sweep the Pallas RNN kernel's batch block size at the config-2
 geometry on the real chip, printing one JSON line per point — the tuning
-evidence behind rnn_scan's block_b default. Set LFM_BENCH_SCAN_IMPL=
-pallas_fused to sweep the fused-projection variant instead.
+evidence behind rnn_scan's block_b default (DESIGN.md §8's falsifiable
+"wider bb lifts MFU" prediction). Set LFM_BENCH_SCAN_IMPL=pallas_fused
+to sweep the fused-projection variant instead.
+
+Each point banks BOTH halves of the workflow:
+  sweep_c2_block_b      — train step at scan_block_b=bb
+  sweep_c2_eval_block_b — the stacked eval sweep at eval_scan_block_b=bb
+    (round-4 verdict ask 7: eval runs at ~train/3 MFU — the same
+    per-step-overhead floor at 1/3 the FLOPs — and, being fwd-only, can
+    afford wider blocks than the backward's VMEM budget allows; the eval
+    list extends to 4096 for exactly that reason).
 
 The trade: bigger blocks mean larger `[bb, H] @ [H, G·H]` MXU matmuls and
 fewer grid steps, but more VMEM per pipeline stage (xw block = bb·G·H
@@ -19,25 +28,26 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import (_backend_name, _scan_impl_override,  # noqa: E402
-                   measure_trainer, persist_row)
+                   measure_eval, measure_trainer, persist_row)
 
 
-def _banked_rows():
+def _banked_rows(metric="sweep_c2_block_b"):
     """TPU sweep rows already in the ledger — a resumed sweep (the
     campaign re-fires after each tunnel heal) must spend chip time only
     on the points a prior pass did not bank."""
     from regen_baseline import ledger_path, load_rows
 
     return [r for r in load_rows(ledger_path())
-            if r.get("metric") == "sweep_c2_block_b"
+            if r.get("metric") == metric
             and r.get("backend") == "tpu"]
 
 
-def sweep(block_sizes) -> None:
+def sweep(block_sizes, eval_sizes=None) -> None:
     from lfm_quant_tpu.config import get_preset
     from lfm_quant_tpu.data import PanelSplits, synthetic_panel
     from lfm_quant_tpu.train import Trainer
 
+    eval_sizes = block_sizes if eval_sizes is None else eval_sizes
     base = get_preset("c2")
     d = base.data
     panel = synthetic_panel(n_firms=d.n_firms, n_months=240,
@@ -57,6 +67,9 @@ def sweep(block_sizes) -> None:
             or ("pallas_fused" if jax.default_backend() == "tpu" else "xla"))
     banked = {r.get("block_b"): float(r.get("value", 0.0))
               for r in _banked_rows() if r.get("scan_impl") == want}
+    banked_eval = {r.get("block_b"): float(r.get("value", 0.0))
+                   for r in _banked_rows("sweep_c2_eval_block_b")
+                   if r.get("scan_impl") == want}
     # Banked points compete in the best-point summary too — a resumed
     # sweep measuring only the residual points must not crown a "best"
     # that the already-banked curve beats (or report 0.0 on a fully
@@ -65,15 +78,27 @@ def sweep(block_sizes) -> None:
     for b, v in banked.items():
         if v > best[1]:
             best = (None if b == "default" else b, v)
-    for bb in block_sizes:
+    # One ordered pass over the union: a size in both lists costs ONE
+    # Trainer build (and its compile) for both halves.
+    seen, ordered = set(), []
+    for bb in list(block_sizes) + list(eval_sizes):
+        if (bb or "default") not in seen:
+            seen.add(bb or "default")
+            ordered.append(bb)
+    for bb in ordered:
         key_bb = bb or "default"
-        if key_bb in banked:
+        do_train = bb in block_sizes and key_bb not in banked
+        do_eval = bb in eval_sizes and key_bb not in banked_eval
+        if not (do_train or do_eval):
             print(json.dumps({"block_b": key_bb, "skipped": "already banked",
-                              "value": banked[key_bb]}), flush=True)
+                              "value": banked.get(key_bb,
+                                                  banked_eval.get(key_bb))}),
+                  flush=True)
             continue
         kw = dict(base.model.kwargs)
         if bb:
             kw["scan_block_b"] = bb
+            kw["eval_scan_block_b"] = bb
         cfg = _scan_impl_override(dataclasses.replace(
             base, model=dataclasses.replace(base.model, kwargs=kw)))
         # The finally releases this point's device panel + compiled
@@ -87,31 +112,46 @@ def sweep(block_sizes) -> None:
             trainer = Trainer(cfg, splits)
             scan_impl, gather_impl = (trainer.model.scan_impl,
                                       trainer._gather_impl)
-            value = measure_trainer(trainer)
+            if do_train:
+                value = measure_trainer(trainer)
+                rec = {"metric": "sweep_c2_block_b",
+                       "block_b": key_bb,
+                       "value": round(value, 1),
+                       "unit": "firm-months/sec/chip",
+                       "scan_impl": scan_impl,
+                       "gather_impl": gather_impl,
+                       "backend": _backend_name()}
+                # Each point is durable the moment it exists (round-3
+                # weak #7: a mid-campaign re-wedge must not lose the
+                # already-measured curve), and block_b is a ledger key
+                # field so points coexist in the table.
+                persist_row(rec)
+                print(json.dumps(rec), flush=True)
+                if value > best[1]:
+                    best = (bb, value)
+            if do_eval:
+                evalue = measure_eval(trainer)
+                rec = {"metric": "sweep_c2_eval_block_b",
+                       "block_b": key_bb,
+                       "value": round(evalue, 1),
+                       "unit": "firm-months/sec/chip",
+                       "scan_impl": scan_impl,
+                       "gather_impl": gather_impl,
+                       "backend": _backend_name()}
+                persist_row(rec)
+                print(json.dumps(rec), flush=True)
         except Exception as e:  # noqa: BLE001 — report the point, keep going
             print(json.dumps({"block_b": bb, "error": f"{type(e).__name__}: {e}"}),
                   flush=True)
             continue
         finally:
             trainer = None
-        rec = {"metric": "sweep_c2_block_b",
-               "block_b": bb or "default",
-               "value": round(value, 1),
-               "unit": "firm-months/sec/chip",
-               "scan_impl": scan_impl,
-               "gather_impl": gather_impl,
-               "backend": _backend_name()}
-        # Each point is durable the moment it exists (round-3 weak #7: a
-        # mid-campaign re-wedge must not lose the already-measured curve),
-        # and block_b is a ledger key field so points coexist in the table.
-        persist_row(rec)
-        print(json.dumps(rec), flush=True)
-        if value > best[1]:
-            best = (bb, value)
     print(json.dumps({"best_block_b": best[0] or "default",
                       "value": round(best[1], 1)}), flush=True)
 
 
 if __name__ == "__main__":
     sizes = [int(a) for a in sys.argv[1:]] or [None, 256, 512, 1024, 2048]
-    sweep(sizes)
+    # Fwd-only eval affords blocks the backward's VMEM budget cannot.
+    evals = sizes if sys.argv[1:] else sizes + [4096]
+    sweep(sizes, evals)

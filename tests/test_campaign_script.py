@@ -39,9 +39,10 @@ BENCH_STUB = textwrap.dedent("""\
     if __name__ == "__main__":
         _count("bench")
         persist_row({"metric": "train_throughput_c2_lstm", "value": 1.0,
-                     "unit": "fm/s", "backend": "tpu"})
+                     "unit": "fm/s", "backend": "tpu", "n_reps": 3})
         persist_row({"metric": "train_throughput_c5_ensemble", "value": 1.0,
-                     "unit": "fm/s", "backend": "tpu", "n_seeds": 16})
+                     "unit": "fm/s", "backend": "tpu", "n_seeds": 16,
+                     "n_reps": 3})
 """)
 
 LADDER_STUB = textwrap.dedent("""\
@@ -64,9 +65,9 @@ LADDER_STUB = textwrap.dedent("""\
     if os.environ.get("STUB_FAIL_FOR") == name:
         sys.exit(124)  # timeout-killed mid-step: NO rows banked
     persist_row({"metric": f"train_throughput_{name}", "value": 2.0,
-                 "unit": "fm/s", "backend": "tpu", **extras})
+                 "unit": "fm/s", "backend": "tpu", "n_reps": 3, **extras})
     persist_row({"metric": f"eval_throughput_{name}", "value": 3.0,
-                 "unit": "fm/s", "backend": "tpu",
+                 "unit": "fm/s", "backend": "tpu", "n_reps": 3,
                  "lane_pad": gi == "pallas", **extras})
 """)
 
@@ -77,6 +78,10 @@ SWEEP_STUB = textwrap.dedent("""\
     _count("sweep")
     for bb in ("default", 256, 512, 1024, 2048):
         persist_row({"metric": "sweep_c2_block_b", "block_b": bb,
+                     "value": 4.0, "unit": "fm/s", "backend": "tpu",
+                     "scan_impl": "pallas_fused"})
+    for bb in ("default", 256, 512, 1024, 2048, 4096):
+        persist_row({"metric": "sweep_c2_eval_block_b", "block_b": bb,
                      "value": 4.0, "unit": "fm/s", "backend": "tpu",
                      "scan_impl": "pallas_fused"})
 """)
@@ -285,3 +290,36 @@ def test_campaign_aborts_on_nonrisky_failure_and_resumes(tmp_path):
     metrics2 = {r["metric"] for r in _rows(repo)}
     assert "train_throughput_c4" in metrics2
     assert "train_throughput_lc" in metrics2
+
+
+@pytest.mark.fast
+def test_bench_fake_wedge_dry_run_is_parseable_and_fast():
+    """Round-4 verdict (Weak #5 / ask 9): the driver capture must stay
+    parseable even if its timebox shrinks below the probe window. The
+    contract: bench.py puts a schema-shaped JSON record on stdout FIRST
+    (provisional), then a structured terminal record, with zero chip
+    contact and the whole run bounded well under the smallest observed
+    driver timebox. The fake-wedge hook exercises exactly the real
+    wedged-tunnel code path minus the subprocess probes."""
+    import sys
+    import time
+
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        env={**os.environ, "LFM_BENCH_FAKE_WEDGE": "1",
+             "LFM_BENCH_NO_PERSIST": "1"},
+        capture_output=True, text=True, timeout=30)
+    took = time.monotonic() - t0
+    assert took < 10, f"dry run took {took:.1f}s (must be <10s)"
+    assert proc.returncode == 1
+    recs = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    assert len(recs) >= 2
+    # First record: the provisional, emitted before anything can hang.
+    assert recs[0]["metric"] == "bench_status"
+    assert recs[0]["status"] == "no_capture"
+    # Last record (what the driver parses): the structured wedge status.
+    assert recs[-1]["metric"] == "bench_status"
+    assert recs[-1]["status"] == "tunnel_wedged"
+    for rec in recs:  # every record is schema-shaped for the driver
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)

@@ -298,3 +298,24 @@ def test_factorized_auto_resolves_to_xla_scan():
     with mock.patch("jax.default_backend", return_value="tpu"):
         _, dense = model_kwargs(get_preset("c2"))
     assert dense["scan_impl"] == "pallas_fused"
+
+
+@pytest.mark.parametrize("impl", ["pallas", "pallas_fused"])
+def test_eval_scan_block_b_routes_deterministic_only(impl):
+    """`eval_scan_block_b` (the fwd-only eval block-width lever, DESIGN.md
+    §9) must change ONLY the deterministic forward's kernel tiling — both
+    passes stay numerically identical to the default-block model, and the
+    train-mode (non-deterministic) forward keeps scan_block_b."""
+    x, m = make_batch()
+    base = build_model("lstm", hidden=16, scan_impl=impl, scan_block_b=8)
+    wide = build_model("lstm", hidden=16, scan_impl=impl, scan_block_b=8,
+                       eval_scan_block_b=16)
+    params = base.init(jax.random.key(0), x, m)
+    np.testing.assert_allclose(
+        np.asarray(base.apply(params, x, m, deterministic=True)),
+        np.asarray(wide.apply(params, x, m, deterministic=True)),
+        rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(base.apply(params, x, m, deterministic=False)),
+        np.asarray(wide.apply(params, x, m, deterministic=False)),
+        rtol=2e-5)

@@ -215,3 +215,20 @@ def test_c5_64_seed_geometry_lowers():
                _sds((S, B, T, H), jnp.bfloat16),
                _sds((S, H, G), jnp.bfloat16), _sds((S, G), jnp.bfloat16),
                _sds((S, H, G), jnp.bfloat16), _sds((S, B, T), jnp.bfloat16))
+
+
+def test_wide_eval_block_fwd_lowers():
+    """The eval sweep's widest block point (eval_scan_block_b=4096,
+    fwd-only — scripts/sweep_rnn_blocks.py's eval curve): a 4096-row
+    block is a new BlockSpec geometry the train path never compiles, so
+    it needs its own Mosaic legality pin before it spends chip time."""
+    B, T, H = 4096, 60, 128
+    G = 4 * H
+
+    def fwd(hin, wx, b, wh, m):
+        return rnn_scan_fused("lstm", hin, wx, b, wh, m,
+                              block_b=4096, interpret=False).sum()
+
+    _lower_tpu(fwd, _sds((B, T, H), jnp.bfloat16),
+               _sds((H, G), jnp.bfloat16), _sds((G,), jnp.bfloat16),
+               _sds((H, G), jnp.bfloat16), _sds((B, T), jnp.bfloat16))
