@@ -126,6 +126,49 @@ def _emit(metric: str, value: float, mfu_pct: float, **extras) -> None:
     persist_row(rec)
 
 
+_RTT_PROBE = None
+
+
+def dispatch_rtt_ms(reps: int = 5):
+    """Tunnel-health covariate: median round-trip of a tiny pre-compiled
+    dispatch + scalar readback. The round-4 c2 captures drifted 41.7→55.4M
+    between harness runs minutes apart with bit-identical geometry — the
+    spread was attributed to tunnel/server state but nothing RECORDED it.
+    Stamped on every measurement row, this lets a later analysis correlate
+    throughput with tunnel latency instead of arguing about it. Cost: one
+    tiny compile + ``reps`` ~25-30 ms round-trips. Never raises — a
+    covariate must not kill a measurement run.
+
+    PLACEMENT CONTRACT: call this BEFORE the measurement it annotates,
+    never between a completed measurement and its persist_row — a
+    post-measurement wedge inside this probe would hang/exit the process
+    holding an unpersisted row, exactly the loss mode persist-at-
+    measurement-time exists to prevent."""
+    global _RTT_PROBE
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if _RTT_PROBE is None:
+            # Compile once per process: the jit cache keys on shape/dtype,
+            # but holding the pair explicitly documents that every call
+            # after the first costs only ~reps round-trips (the first
+            # costs one small tunnel compile).
+            _RTT_PROBE = (jax.jit(lambda a: (a @ a).sum()),
+                          jnp.ones((128, 128), jnp.bfloat16))
+        f, x = _RTT_PROBE
+        float(f(x))  # compile + first round-trip outside the timing
+        vals = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(f(x))
+            vals.append((time.perf_counter() - t0) * 1e3)
+        vals.sort()
+        return round(vals[len(vals) // 2], 2)
+    except Exception:  # noqa: BLE001 — diagnostic only
+        return None
+
+
 def measure_with_spread(fn, outer_reps: int = 0):
     """Round-4 verdict (Weak #1): the same geometry measured 55.4M and
     41.7M fm/s minutes apart — absolute numbers need error bars. Run a
@@ -296,6 +339,7 @@ def bench_c2() -> None:
     )
     splits = PanelSplits.by_date(panel, 198601, 198801)
     trainer = Trainer(cfg, splits)
+    rtt = dispatch_rtt_ms()  # covariate BEFORE the measurement (contract)
     value, spread = measure_with_spread(lambda: measure_trainer(
         trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "30"))))
     flops = _lstm_train_flops_per_fm(
@@ -305,7 +349,7 @@ def bench_c2() -> None:
     _emit("train_throughput_c2_lstm", value,
           100.0 * value * flops / V5E_BF16_PEAK,
           scan_impl=trainer.model.scan_impl,
-          gather_impl=trainer._gather_impl, **spread)
+          gather_impl=trainer._gather_impl, rtt_ms=rtt, **spread)
 
 
 def bench_c5_ensemble() -> None:
@@ -331,6 +375,7 @@ def bench_c5_ensemble() -> None:
     )
     splits = PanelSplits.by_date(panel, 198601, 198801)
     trainer = EnsembleTrainer(cfg, splits)
+    rtt = dispatch_rtt_ms()  # covariate BEFORE the measurement (contract)
     value, spread = measure_with_spread(lambda: measure_ensemble_trainer(
         trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10"))))
     # value counts all seeds; one chip hosts the whole seed stack.
@@ -341,7 +386,7 @@ def bench_c5_ensemble() -> None:
           n_seeds=n_seeds,
           per_seed_fm_s=round(value / n_seeds, 1),
           scan_impl=trainer.inner.model.scan_impl,
-          gather_impl=trainer.inner._gather_impl,
+          gather_impl=trainer.inner._gather_impl, rtt_ms=rtt,
           **({"seed_block": seed_block} if seed_block else {}),
           **spread)
 

@@ -157,7 +157,7 @@ probe after-seeds64b
 # Points persist individually; the guard needs both curves complete.
 have metric=sweep_c2_block_b --distinct block_b --min-count 5 &&
 have metric=sweep_c2_eval_block_b --distinct block_b --min-count 6 ||
-TMO=1200 step sweep-blocks python scripts/sweep_rnn_blocks.py
+TMO=1800 step sweep-blocks python scripts/sweep_rnn_blocks.py
 probe after-sweep
 
 # The c1 suspect, isolated (see scripts/diag_c1.py): first the
