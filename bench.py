@@ -174,26 +174,31 @@ def measure_with_spread(fn, outer_reps: int = 0):
     41.7M fm/s minutes apart — absolute numbers need error bars. Run a
     complete measurement callable ``outer_reps`` times (each inner call
     keeps its own warmup/sync discipline untouched) and return
-    ``(median, extras)`` where extras carries the spread for the ledger
-    row. LFM_BENCH_OUTER_REPS overrides (default 3; 1 = legacy single
-    shot, extras empty). The median is robust to one tunnel hiccup; the
-    recorded spread keeps the headline honest."""
+    ``(median, extras)`` where extras carries the spread AND the rtt_ms
+    tunnel-latency covariate for the ledger row. The covariate is probed
+    HERE, before the first measurement pass, so the placement contract
+    (dispatch_rtt_ms docstring: never between a measurement and its
+    persist) holds structurally at every call site — no row that rides
+    this chokepoint can ship without it. LFM_BENCH_OUTER_REPS overrides
+    (default 3; 1 = legacy single shot, no spread fields). The median is
+    robust to one tunnel hiccup; the recorded spread keeps the headline
+    honest."""
     outer_reps = outer_reps or int(os.environ.get("LFM_BENCH_OUTER_REPS",
                                                   "3"))
+    rtt = dispatch_rtt_ms()
     vals = [fn() for _ in range(max(1, outer_reps))]
     vals.sort()
     med = vals[len(vals) // 2] if len(vals) % 2 else (
         0.5 * (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]))
-    if len(vals) < 2:
-        # Still tag the rep count: the campaign's `--has n_reps` resume
-        # guards key on the field's PRESENCE, so a deliberate single-shot
-        # run (LFM_BENCH_OUTER_REPS=1) must satisfy them too.
-        return med, {"n_reps": 1}
-    return med, {
-        "n_reps": len(vals),
-        "spread_pct": round(100.0 * (vals[-1] - vals[0]) / med, 1),
-        "rep_values": [round(v, 1) for v in vals],
-    }
+    extras = {"rtt_ms": rtt} if rtt is not None else {}
+    # Always tag the rep count: the campaign's `--has n_reps` resume
+    # guards key on the field's PRESENCE, so a deliberate single-shot
+    # run (LFM_BENCH_OUTER_REPS=1) must satisfy them too.
+    extras["n_reps"] = len(vals)
+    if len(vals) >= 2:
+        extras["spread_pct"] = round(100.0 * (vals[-1] - vals[0]) / med, 1)
+        extras["rep_values"] = [round(v, 1) for v in vals]
+    return med, extras
 
 
 def measure_trainer(trainer, k: int = 30, reps: int = 3) -> float:
@@ -339,7 +344,6 @@ def bench_c2() -> None:
     )
     splits = PanelSplits.by_date(panel, 198601, 198801)
     trainer = Trainer(cfg, splits)
-    rtt = dispatch_rtt_ms()  # covariate BEFORE the measurement (contract)
     value, spread = measure_with_spread(lambda: measure_trainer(
         trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "30"))))
     flops = _lstm_train_flops_per_fm(
@@ -349,7 +353,7 @@ def bench_c2() -> None:
     _emit("train_throughput_c2_lstm", value,
           100.0 * value * flops / V5E_BF16_PEAK,
           scan_impl=trainer.model.scan_impl,
-          gather_impl=trainer._gather_impl, rtt_ms=rtt, **spread)
+          gather_impl=trainer._gather_impl, **spread)
 
 
 def bench_c5_ensemble() -> None:
@@ -375,7 +379,6 @@ def bench_c5_ensemble() -> None:
     )
     splits = PanelSplits.by_date(panel, 198601, 198801)
     trainer = EnsembleTrainer(cfg, splits)
-    rtt = dispatch_rtt_ms()  # covariate BEFORE the measurement (contract)
     value, spread = measure_with_spread(lambda: measure_ensemble_trainer(
         trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10"))))
     # value counts all seeds; one chip hosts the whole seed stack.
@@ -386,7 +389,7 @@ def bench_c5_ensemble() -> None:
           n_seeds=n_seeds,
           per_seed_fm_s=round(value / n_seeds, 1),
           scan_impl=trainer.inner.model.scan_impl,
-          gather_impl=trainer.inner._gather_impl, rtt_ms=rtt,
+          gather_impl=trainer.inner._gather_impl,
           **({"seed_block": seed_block} if seed_block else {}),
           **spread)
 

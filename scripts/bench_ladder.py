@@ -32,7 +32,6 @@ from bench import (  # noqa: E402
     measure_eval,
     measure_trainer,
     measure_with_spread,
-    dispatch_rtt_ms,
     persist_row,
 )
 
@@ -168,14 +167,12 @@ def bench_config(name: str):
             extras["seed_block"] = seed_block
         _log(f"{name}: building EnsembleTrainer ({cfg.n_seeds} seeds)")
         trainer = EnsembleTrainer(cfg, splits)
-        rtt = dispatch_rtt_ms()  # covariate BEFORE the measurement
         _log(f"{name}: measuring train (compile on first dispatch)")
         value, spread = measure_with_spread(lambda: measure_ensemble_trainer(
             trainer, k=int(os.environ.get("LFM_BENCH_STEPS", "10"))))
     else:
         _log(f"{name}: building Trainer")
         trainer = Trainer(cfg, splits)
-        rtt = dispatch_rtt_ms()  # covariate BEFORE the measurement
         _log(f"{name}: gather={trainer._gather_impl}; measuring train "
              "(compile on first dispatch)")
         value, spread = measure_with_spread(lambda: measure_trainer(
@@ -198,11 +195,9 @@ def bench_config(name: str):
         "mfu_pct": round(100.0 * value * flops / V5E_BF16_PEAK, 2),
         "config": cfg.name,
         "loss": cfg.optim.loss,
-        "rtt_ms": rtt,
         **extras,
         **spread,
     }
-    eval_rtt = dispatch_rtt_ms()  # FRESH covariate: minutes have passed
     _log(f"{name}: measuring eval sweep")
     eval_value, eval_spread = measure_with_spread(
         lambda: measure_eval(trainer))
@@ -228,7 +223,6 @@ def bench_config(name: str):
                          / V5E_BF16_PEAK, 2),
         "config": cfg.name,
         "eval_path": eval_path(trainer),
-        "rtt_ms": eval_rtt,
         **eval_extras,
         **eval_spread,
     }

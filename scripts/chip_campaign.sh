@@ -155,6 +155,11 @@ probe after-seeds64b
 # and the fwd-only eval sweep (6 points — 4096 extra, affordable without
 # the backward's VMEM budget; round-4 verdict ask 7's eval lever).
 # Points persist individually; the guard needs both curves complete.
+# TMO note: per-point measurement is sub-second (a 30-step in-jit scan
+# dispatch is ~100 ms at c2 throughput; 3 outer reps add seconds across
+# 11 point-halves) — the budget is ~6 train+eval COMPILES at 60-120 s
+# each, unchanged by the spread protocol. 1800 s covers that with >2×
+# headroom; the step is expected-risky either way (no abort on timeout).
 have metric=sweep_c2_block_b --distinct block_b --min-count 5 &&
 have metric=sweep_c2_eval_block_b --distinct block_b --min-count 6 ||
 TMO=1800 step sweep-blocks python scripts/sweep_rnn_blocks.py

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Cross-capture drift report for one geometry (default: c2 train).
+
+Round-4 verdict ask 2: the 55.4M-vs-41.7M same-geometry spread must be
+"resolved to <10% or explained by a recorded tunnel-health covariate".
+The rows now carry both instruments — per-row median-of-reps spreads
+(spread_pct) and the rtt_ms tunnel-latency covariate — and this script
+is the one-command analysis over them: every capture of the geometry in
+chronological order, the cross-capture spread of the medians, and the
+rtt correlation when there is enough data to say anything.
+
+Run: python scripts/drift_report.py [metric_prefix ...]
+     (default prefixes: train_throughput_c2 eval_throughput_c2)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from regen_baseline import (ledger_path, load_rows,  # noqa: E402
+                            measurement_rows, row_key)
+
+
+def report(prefixes) -> int:
+    rows = [r for r in measurement_rows(load_rows(ledger_path()))
+            if isinstance(r.get("value"), (int, float))
+            and any(str(r.get("metric", "")).startswith(p)
+                    for p in prefixes)]
+    if not rows:
+        print(f"no tpu rows match prefixes {prefixes}")
+        return 1
+    print(f"{'ts':20} {'metric':28} {'impl':14} {'value':>14} "
+          f"{'±%':>6} {'rtt_ms':>7}")
+    for r in rows:  # ledger order == chronological
+        impl = r.get("gather_impl") or r.get("scan_impl") or "-"
+        spread = r.get("spread_pct")
+        print(f"{r.get('ts', '?'):20} {r.get('metric', '?'):28} "
+              f"{impl:14} {r.get('value', 0):>14,.0f} "
+              f"{spread if spread is not None else '—':>6} "
+              f"{r.get('rtt_ms') if r.get('rtt_ms') is not None else '—':>7}")
+    # Group by the CANONICAL measurement identity (regen_baseline's
+    # row_key — metric + every KEY_FIELD) so deliberate A/B variants
+    # (gather legs, lane_pad panel layouts, dates_per_batch geometries)
+    # are never conflated into fake "drift": only repeat captures of the
+    # SAME program + geometry form a group.
+    groups = {}
+    for r in rows:
+        groups.setdefault(row_key(r), []).append(r)
+    print()
+    for key, grp in groups.items():
+        if len(grp) < 2:
+            continue
+        vals = [float(r["value"]) for r in grp]
+        drift = 100.0 * (max(vals) - min(vals)) / min(vals)
+        within = [r.get("spread_pct") for r in grp
+                  if r.get("spread_pct") is not None]
+        verdict = ("RESOLVED (<10%)" if drift < 10.0 else
+                   "within per-capture spread" if within
+                   and drift <= max(within) else "environmental drift")
+        rtts = [(r.get("rtt_ms"), float(r["value"])) for r in grp
+                if r.get("rtt_ms") is not None]
+        rtt_note = ""
+        if len(rtts) >= 2:
+            hi_rtt = max(rtts)[0]
+            lo_rtt = min(rtts)[0]
+            if hi_rtt and lo_rtt and hi_rtt > 1.5 * lo_rtt:
+                slower_at_hi = max(rtts)[1] < min(rtts)[1]
+                rtt_note = (" — rtt covariate moves with it"
+                            if slower_at_hi else
+                            " — rtt covariate does NOT explain it")
+        tags = ", ".join(f"{k}={v}" for k, v in key[1:] if v is not None)
+        print(f"{key[0]} ({tags or '-'}): "
+              f"{len(grp)} captures, cross-capture drift {drift:.1f}% "
+              f"→ {verdict}{rtt_note}")
+    # The original mystery spans two HARNESSES (bench.py's
+    # train_throughput_c2_lstm vs bench_ladder's train_throughput_c2,
+    # pallas leg) — compare their latest captures explicitly.
+    bench_rows = [r for r in rows
+                  if r.get("metric") == "train_throughput_c2_lstm"
+                  and r.get("gather_impl") == "pallas"]
+    ladder_rows = [r for r in rows
+                   if r.get("metric") == "train_throughput_c2"
+                   and r.get("gather_impl") == "pallas"]
+    if bench_rows and ladder_rows:
+        b, l = bench_rows[-1], ladder_rows[-1]
+        pair = sorted([float(b["value"]), float(l["value"])])
+        gap = 100.0 * (pair[1] - pair[0]) / pair[0]
+        spreads = [r.get("spread_pct") for r in (b, l)
+                   if r.get("spread_pct") is not None]
+        print(f"cross-harness c2 pair (bench {b.get('ts')} vs ladder "
+              f"{l.get('ts')}): gap {gap:.1f}%"
+              + (f", per-capture spreads {spreads}" if spreads else
+                 " (pre-protocol captures: no per-row spreads)"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(report(sys.argv[1:] or
+                    ["train_throughput_c2", "eval_throughput_c2"]))

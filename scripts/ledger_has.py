@@ -28,7 +28,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from regen_baseline import ledger_path, load_rows  # noqa: E402
+from regen_baseline import ledger_path, load_rows, measurement_rows  # noqa: E402
 
 
 def main(argv) -> int:
@@ -50,9 +50,8 @@ def main(argv) -> int:
     for a in args:
         k, _, v = a.partition("=")
         filters[k] = v
-    hits = [row for row in load_rows(ledger_path())
-            if row.get("unit") != "status" and row.get("backend") == "tpu"
-            and all(str(row.get(k, None)) == v for k, v in filters.items())
+    hits = [row for row in measurement_rows(load_rows(ledger_path()))
+            if all(str(row.get(k, None)) == v for k, v in filters.items())
             and all(k in row for k in has_keys)]
     n = (len({str(r.get(distinct_key, None)) for r in hits}) if distinct_key
          else len(hits))

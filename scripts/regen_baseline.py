@@ -36,6 +36,28 @@ def ledger_path():
         REPO, "BENCH_ROWS.jsonl")
 
 
+def measurement_rows(rows, backend="tpu"):
+    """The canonical 'which ledger rows count as real measurements'
+    filter, shared by ledger_has (resume guards), drift_report, and any
+    future consumer: status/outage records never count, and (by default)
+    neither do non-TPU smoke rows — a CPU run must not satisfy a chip
+    guard or enter a chip drift analysis. ``backend=None`` disables the
+    backend filter."""
+    return [r for r in rows
+            if r.get("unit") != "status"
+            and (backend is None or r.get("backend") == backend)]
+
+
+def row_key(row):
+    """The canonical measurement identity: metric + every KEY_FIELD
+    (absent == None, so a row missing a field never forks a near-
+    duplicate key from one carrying it as None). render_table and
+    drift_report must agree on this — two rows that the table shows as
+    one measurement line are repeat captures, not different programs."""
+    return (row.get("metric"),) + tuple(
+        (k, row.get(k)) for k in KEY_FIELDS)
+
+
 def load_rows(path):
     rows = []
     try:
@@ -61,11 +83,7 @@ def render_table(rows):
         if row.get("unit") == "status":
             status.append(row)
             continue
-        # Every key field participates (absent == None) so the key shape
-        # is stable across rows — a row missing a field never silently
-        # forks a near-duplicate key from one that carries it as None.
-        key = (row.get("metric"),) + tuple(
-            (k, row.get(k)) for k in KEY_FIELDS)
+        key = row_key(row)
         measured[key] = row
         if isinstance(row.get("value"), (int, float)):
             history.setdefault(key, []).append(float(row["value"]))

@@ -28,8 +28,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import (_backend_name, _scan_impl_override,  # noqa: E402
-                   dispatch_rtt_ms, measure_eval, measure_trainer,
-                   measure_with_spread, persist_row)
+                   measure_eval, measure_trainer, measure_with_spread,
+                   persist_row)
 
 
 def _banked_rows(metric="sweep_c2_block_b"):
@@ -114,7 +114,6 @@ def sweep(block_sizes, eval_sizes=None) -> None:
             scan_impl, gather_impl = (trainer.model.scan_impl,
                                       trainer._gather_impl)
             if do_train:
-                rtt = dispatch_rtt_ms()  # covariate BEFORE the measurement
                 value, vspread = measure_with_spread(
                     lambda: measure_trainer(trainer))
                 rec = {"metric": "sweep_c2_block_b",
@@ -124,7 +123,7 @@ def sweep(block_sizes, eval_sizes=None) -> None:
                        "scan_impl": scan_impl,
                        "gather_impl": gather_impl,
                        "backend": _backend_name(),
-                       "rtt_ms": rtt, **vspread}
+                       **vspread}
                 # Each point is durable the moment it exists (round-3
                 # weak #7: a mid-campaign re-wedge must not lose the
                 # already-measured curve), and block_b is a ledger key
@@ -134,7 +133,6 @@ def sweep(block_sizes, eval_sizes=None) -> None:
                 if value > best[1]:
                     best = (bb, value)
             if do_eval:
-                ertt = dispatch_rtt_ms()  # covariate BEFORE the measurement
                 evalue, espread = measure_with_spread(
                     lambda: measure_eval(trainer))
                 rec = {"metric": "sweep_c2_eval_block_b",
@@ -144,7 +142,7 @@ def sweep(block_sizes, eval_sizes=None) -> None:
                        "scan_impl": scan_impl,
                        "gather_impl": gather_impl,
                        "backend": _backend_name(),
-                       "rtt_ms": ertt, **espread}
+                       **espread}
                 persist_row(rec)
                 print(json.dumps(rec), flush=True)
         except Exception as e:  # noqa: BLE001 — report the point, keep going

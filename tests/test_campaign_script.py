@@ -323,3 +323,30 @@ def test_bench_fake_wedge_dry_run_is_parseable_and_fast():
     assert recs[-1]["status"] == "tunnel_wedged"
     for rec in recs:  # every record is schema-shaped for the driver
         assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+
+
+@pytest.mark.fast
+def test_ledger_has_presence_guard(tmp_path):
+    """`--has KEY` matches on field presence with ANY value — the resume
+    guard for spread-carrying rows, rep-count-agnostic by design (an
+    operator's LFM_BENCH_OUTER_REPS=1/5 choice must still satisfy it)."""
+    import sys
+
+    ledger = tmp_path / "rows.jsonl"
+    rows = [
+        {"metric": "m", "value": 1.0, "unit": "u", "backend": "tpu"},
+        {"metric": "m", "value": 2.0, "unit": "u", "backend": "tpu",
+         "n_reps": 1},
+    ]
+    ledger.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    env = {**os.environ, "LFM_BENCH_ROWS": str(ledger)}
+
+    def has(*args):
+        return subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "ledger_has.py"),
+             *args], env=env).returncode == 0
+
+    assert has("metric=m")
+    assert has("metric=m", "--has", "n_reps")          # any rep count
+    assert not has("metric=m", "--has", "spread_pct")  # truly absent
+    assert not has("metric=absent", "--has", "n_reps")
