@@ -115,7 +115,8 @@ def sweep(block_sizes, eval_sizes=None) -> None:
                                       trainer._gather_impl)
             if do_train:
                 value, vspread = measure_with_spread(
-                    lambda: measure_trainer(trainer))
+                    lambda: measure_trainer(trainer, k=int(
+                        os.environ.get("LFM_BENCH_STEPS", "30"))))
                 rec = {"metric": "sweep_c2_block_b",
                        "block_b": key_bb,
                        "value": round(value, 1),
