@@ -394,6 +394,111 @@ def bench_c5_ensemble() -> None:
           **spread)
 
 
+def bench_walkforward_reuse() -> None:
+    """walkforward_reuse — the cross-fold reuse layer's ledger metric:
+    folds/hour at the WARM-fold rate plus compiles-per-fold, measured on
+    a same-shape toy walk-forward (train/reuse.py).
+
+    Each fold is timed as its own incremental ``run_walkforward`` call
+    (``resume=True`` continues the sweep; the in-process program/panel
+    caches persist across calls exactly as they do across folds), so the
+    row separates fold 1 — which pays tracing, XLA compilation and the
+    panel H2D once — from the warm folds that must pay neither:
+    ``compiles_per_warm_fold`` and ``transfers_per_warm_fold`` are 0 by
+    the reuse layer's contract (tests/test_reuse.py asserts it; this row
+    MEASURES it per backend), and ``fold2_speedup`` is the wall-clock win
+    the amortization argument predicts. Toy MLP geometry on purpose: the
+    metric prices the FIXED costs, not model throughput — c2/c5 own that.
+    """
+    import shutil
+    import tempfile
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.train.walkforward import run_walkforward
+
+    n_folds = max(2, int(os.environ.get("LFM_BENCH_WF_FOLDS", "3")))
+    cfg = RunConfig(
+        name="wf_reuse_bench",
+        data=DataConfig(n_firms=100, n_months=200, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=2, warmup_steps=5, loss="mse"),
+        seed=0,
+    )
+    panel = synthetic_panel(n_firms=100, n_months=200, n_features=5, seed=5)
+    rtt = dispatch_rtt_ms()
+    out = tempfile.mkdtemp(prefix="lfm_wf_reuse_bench_")
+    try:
+        fold_s = []
+        for k in range(1, n_folds + 1):
+            t0 = time.perf_counter()
+            _, _, summary = run_walkforward(
+                cfg, panel, start=198001, step_months=12, val_months=24,
+                n_folds=k, out_dir=out, resume=k > 1, train_months=72)
+            fold_s.append(round(time.perf_counter() - t0, 2))
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+    reuse = [r["reuse"] for r in summary["folds"]]
+    warm, warm_s = reuse[1:], fold_s[1:]
+    warm_rate = 3600.0 * len(warm_s) / max(sum(warm_s), 1e-9)
+    extras = {
+        "unit": "folds/hour",
+        "n_folds": n_folds,
+        "fold_s": fold_s,
+        "fold2_speedup": round(fold_s[0] / max(fold_s[1], 1e-9), 2),
+        "compiles_fold1": reuse[0]["jit_traces"],
+        "compiles_per_warm_fold": round(
+            sum(r["jit_traces"] for r in warm) / len(warm), 2),
+        "transfers_per_warm_fold": round(
+            sum(r["panel_transfers"] for r in warm) / len(warm), 2),
+        "panel_mb": round(reuse[0]["panel_bytes"] / 2**20, 1),
+    }
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("walkforward_reuse", warm_rate, 0.0, **extras)
+
+
+def _walkforward_reuse_cpu_fallback(budget_s: float) -> bool:
+    """Wedged-tunnel fallback for the walkforward_reuse metric: the
+    quantity it prices (compiles/transfers per warm fold) is backend-
+    independent, so when the axon tunnel is wedged the row is measured in
+    a CPU SUBPROCESS (JAX_PLATFORMS=cpu; jax must not be imported in the
+    wedged parent — see _tunnel_probe) instead of being lost with the
+    throughput metrics. The child persists its own row (tagged
+    backend=cpu by _backend_name) and its stdout is forwarded so the
+    driver's tail parse sees it before the terminal tunnel_wedged status.
+    Returns True when the child produced a row; failures never mask the
+    outage path."""
+    import subprocess
+
+    if budget_s < 30:
+        return False
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # The wedge is in the tunneled backend plugin; a forced-CPU child
+    # must not inherit a half-claimed device.
+    env.pop("LFM_BENCH_SKIP_PROBE", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--walkforward-reuse"],
+            env=env, capture_output=True, text=True,
+            timeout=min(budget_s, 240))
+    except Exception as e:  # noqa: BLE001 — a salvage attempt must never
+        # replace the terminal tunnel_wedged record with bench_error
+        # (test_bench_wedged_tunnel_emits_status_record pins this).
+        print(f"[bench] CPU walkforward_reuse fallback failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+        return False
+    sys.stdout.write(out.stdout)
+    sys.stdout.flush()
+    if out.returncode != 0:
+        print(f"[bench] CPU walkforward_reuse fallback failed: "
+              f"{out.stderr.strip()[-300:]}", file=sys.stderr, flush=True)
+    return out.returncode == 0 and bool(out.stdout.strip())
+
+
 def _tunnel_probe(wait_s: float = 420.0) -> dict:
     """Fail FAST (and diagnosably) when the tunneled device is wedged.
 
@@ -718,15 +823,24 @@ def main() -> int:
         # diagnosis), and the float() parses sit INSIDE the try so a
         # malformed knob still exits through the bench_error record.
         wait_s = float(os.environ.get("LFM_BENCH_WAIT_S", "420"))
-        watchdog = _arm_watchdog(max(
-            float(os.environ.get("LFM_BENCH_DEADLINE_S", "540")),
-            wait_s + 120.0), preempted)
+        deadline_s = max(float(os.environ.get("LFM_BENCH_DEADLINE_S", "540")),
+                         wait_s + 120.0)
+        watchdog = _arm_watchdog(deadline_s, preempted)
         if os.environ.get("LFM_BENCH_FAKE_WEDGE") != "1":
             # A fake-wedge dry run must never SIGTERM the real recovery
             # watcher holding the staged campaign.
             preempted.update(_preempt_campaign())
         probe = _tunnel_probe(wait_s)
         if not probe["ok"]:
+            # Salvage the backend-independent metric on CPU before the
+            # terminal outage record (skipped for dry runs — a fake
+            # wedge must stay a <10 s no-chip path for the campaign
+            # tests). Leaves 30 s of watchdog headroom so a slow child
+            # can never turn the structured give-up into an os._exit.
+            if (os.environ.get("LFM_BENCH_FAKE_WEDGE") != "1"
+                    and probe.get("kind") == "tunnel_wedged"):
+                _walkforward_reuse_cpu_fallback(
+                    deadline_s - (time.monotonic() - t_start) - 30.0)
             # A FAKE_WEDGE dry run must not bank a bogus outage record in
             # the durable ledger — regen_baseline reports the latest
             # status row, and a fake one would misreport a healthy tunnel.
@@ -751,6 +865,14 @@ def main() -> int:
             _emit_status("bench_error", stage="c5_ensemble",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
+        try:
+            bench_walkforward_reuse()
+        except Exception as e:  # noqa: BLE001 — throughput rows must still reach the driver
+            print(f"bench_walkforward_reuse failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            _emit_status("bench_error", stage="walkforward_reuse",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
         return 0
     except Exception as e:  # noqa: BLE001 — NO exit path may skip the record
         _emit_status("bench_error", stage="harness",
@@ -764,5 +886,20 @@ def main() -> int:
             _rearm_watcher(preempted)
 
 
+def _reuse_only_main() -> int:
+    """``bench.py --walkforward-reuse``: the single-metric entry point —
+    no probe, no watchdog, no campaign preemption. The caller owns the
+    backend choice (the CPU fallback sets JAX_PLATFORMS=cpu) and the
+    timebox (subprocess timeout)."""
+    try:
+        bench_walkforward_reuse()
+        return 0
+    except Exception as e:  # noqa: BLE001 — the parent expects a record or rc!=0
+        _emit_status("bench_error", stage="walkforward_reuse",
+                     detail=f"{type(e).__name__}: {e}"[:300])
+        return 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_reuse_only_main() if "--walkforward-reuse" in sys.argv[1:]
+             else main())

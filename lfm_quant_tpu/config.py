@@ -34,6 +34,11 @@ class DataConfig:
     # Date splits (YYYYMM): computed from panel range when None.
     train_end: Optional[int] = None
     val_end: Optional[int] = None
+    # Rolling train window start (YYYYMM): None = expanding window (train
+    # on all history up to train_end). Walk-forward pins this per fold
+    # when ``train_months`` is set, so fold run dirs reload with the
+    # exact rolling boundaries they trained under.
+    train_start: Optional[int] = None
     panel_path: Optional[str] = None  # load a real panel instead of synthetic
     # Which (standardized) feature column the model forecasts ``horizon``
     # months ahead — real panels only (data/compustat.py); None = the
@@ -125,6 +130,13 @@ class RunConfig:
     # seed count. Trades step-level parallelism for memory; throughput is
     # unchanged when the per-block batch already fills the chip.
     seed_block: int = 0
+    # JAX persistent compilation cache directory (train/reuse.py
+    # enable_persistent_cache): compiled XLA programs are written here so
+    # even a COLD process skips re-optimization — the cross-process twin
+    # of the in-process compiled-program cache that makes walk-forward
+    # folds compile once. None = env fallback LFM_COMPILATION_CACHE,
+    # else off. (JAX's own JAX_COMPILATION_CACHE_DIR also still works.)
+    compilation_cache_dir: Optional[str] = None
     out_dir: str = "runs"
 
     @property
@@ -150,6 +162,7 @@ class RunConfig:
             n_data_shards=raw.get("n_data_shards", 1),
             n_seq_shards=raw.get("n_seq_shards", 1),
             seed_block=raw.get("seed_block", 0),
+            compilation_cache_dir=raw.get("compilation_cache_dir"),
             out_dir=raw.get("out_dir", "runs"),
         )
 

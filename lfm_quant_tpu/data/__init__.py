@@ -5,10 +5,13 @@ from lfm_quant_tpu.data.windows import (
     DateBatchSampler,
     WindowIndex,
     anchor_index,
+    cached_device_panel,
+    clear_panel_cache,
     device_panel,
     gather_targets,
     gather_windows,
     gather_windows_packed,
+    invalidate_panel,
 )
 
 __all__ = [
@@ -19,8 +22,11 @@ __all__ = [
     "WindowIndex",
     "anchor_index",
     "DateBatchSampler",
+    "cached_device_panel",
+    "clear_panel_cache",
     "device_panel",
     "gather_targets",
     "gather_windows",
     "gather_windows_packed",
+    "invalidate_panel",
 ]

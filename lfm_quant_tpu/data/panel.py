@@ -400,34 +400,45 @@ class PanelSplits:
     panel: Panel
     train_end_idx: int  # first month index NOT in train
     val_end_idx: int    # first month index NOT in val
+    # First month index IN train: 0 = expanding window (train on all
+    # history, the reference protocol); nonzero = rolling window (fixed-
+    # length train periods — the walk-forward mode whose folds keep
+    # identical batch shapes, which is what lets the cross-fold reuse
+    # layer bind one set of compiled programs for the whole sweep).
+    train_start_idx: int = 0
 
     @staticmethod
-    def by_date(panel: Panel, train_end: int, val_end: int) -> "PanelSplits":
-        """Boundaries as YYYYMM: train = [start, train_end), val =
-        [train_end, val_end), test = [val_end, end). Each period must be
+    def by_date(panel: Panel, train_end: int, val_end: int,
+                train_start: Optional[int] = None) -> "PanelSplits":
+        """Boundaries as YYYYMM: train = [train_start, train_end), val =
+        [train_end, val_end), test = [val_end, end). ``train_start``
+        None = panel start (expanding window). Each period must be
         longer than ``panel.horizon`` so the target-embargoed anchor ranges
         (see ``train_range``/``val_range``) stay non-empty."""
         dates = panel.dates
         t_idx = int(np.searchsorted(dates, train_end))
         v_idx = int(np.searchsorted(dates, val_end))
+        s_idx = (int(np.searchsorted(dates, train_start))
+                 if train_start is not None else 0)
         if not (0 < t_idx < v_idx < panel.n_months):
             raise ValueError(
                 f"split boundaries ({train_end}, {val_end}) must fall "
                 f"strictly inside the panel's date range "
                 f"[{dates[0]}, {dates[-1]}] in order")
         h = panel.horizon
-        if t_idx <= h or v_idx - t_idx <= h:
+        if t_idx - s_idx <= h or v_idx - t_idx <= h:
             raise ValueError(
-                f"train period ({t_idx} months) and val period "
+                f"train period ({t_idx - s_idx} months) and val period "
                 f"({v_idx - t_idx} months) must each exceed the target "
                 f"horizon ({h} months) for embargoed anchors to exist")
-        return PanelSplits(panel=panel, train_end_idx=t_idx, val_end_idx=v_idx)
+        return PanelSplits(panel=panel, train_end_idx=t_idx,
+                           val_end_idx=v_idx, train_start_idx=s_idx)
 
     @property
     def train_range(self) -> tuple:
         """Anchor range for training, embargoed so targets (realized
         ``horizon`` months after the anchor) stay inside the train period."""
-        return (0, self.train_end_idx - self.panel.horizon)
+        return (self.train_start_idx, self.train_end_idx - self.panel.horizon)
 
     @property
     def val_range(self) -> tuple:

@@ -29,6 +29,7 @@ SURVEY.md §8 step 8 says to decide up front.
 from __future__ import annotations
 
 import dataclasses
+import weakref
 from typing import Iterator, Optional
 
 import jax
@@ -153,6 +154,15 @@ class DateBatchSampler:
         self.engine = engine
         eligible = anchor_index(panel, window, min_valid_months,
                                 require_target=require_target)
+        # Panel-wide max cross-section, computed BEFORE the date_range
+        # bound: the static eval padding width (full_cross_sections).
+        # Range-local padding would make eval batch shapes a function of
+        # the split boundaries — every walk-forward fold would re-trace
+        # the eval/predict forward for a new [M, bf] even when the
+        # program cache handed it fold 1's executables. A panel-level
+        # constant keeps the shape fold-invariant at the cost of a few
+        # weight-0 pad columns on thin ranges.
+        self._eval_bf = int(eligible.sum(axis=0).max())
         if date_range is not None:
             lo, hi = date_range
             if not (0 <= lo < hi <= panel.n_months):
@@ -308,10 +318,13 @@ class DateBatchSampler:
 
     def full_cross_sections(self) -> Iterator[WindowIndex]:
         """Deterministic sweep over every eligible (date, firm) pair, for
-        eval/inference: each batch is one date's full cross-section padded to
-        the max cross-section size. Covers ALL dates with eligible anchors,
-        including those below the training ``min_cross_section`` filter."""
-        bf = max(self._firms_by_date[int(t)].size for t in self._all_dates)
+        eval/inference: each batch is one date's full cross-section padded
+        to the PANEL-wide max cross-section (``_eval_bf`` — computed before
+        any date_range bound, so the batch shape is split-invariant and
+        walk-forward folds reuse one compiled eval program). Covers ALL
+        dates with eligible anchors, including those below the training
+        ``min_cross_section`` filter."""
+        bf = self._eval_bf
         for t in self._all_dates:
             pool = self._firms_by_date[int(t)]
             firm_idx = np.empty((1, bf), dtype=np.int32)
@@ -393,7 +406,10 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
     ``panel.n_features + 1`` (callers pass it as ``fp``); phantom months
     carry validity 0.
     """
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
     put = (lambda x: jax.device_put(x, sharding)) if sharding is not None else jnp.asarray
+    REUSE_COUNTERS.panel_transfers += 1
     xm = np.concatenate(
         [panel.features, panel.valid[..., None].astype(panel.features.dtype)],
         axis=-1,
@@ -425,7 +441,82 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
     if raw:
         dev["features"] = put(panel.features)
         dev["valid"] = put(panel.valid)
+    REUSE_COUNTERS.panel_bytes += int(
+        xm.nbytes + panel.targets.nbytes + panel.target_valid.nbytes
+        + (panel.features.nbytes + panel.valid.nbytes if raw else 0))
     return dev
+
+
+# ---- shared device-panel residency (cross-fold reuse layer) ------------
+#
+# A walk-forward sweep re-transfers the SAME HBM-resident panel once per
+# fold because every fold's Trainer calls device_panel afresh. Over the
+# axon tunnel (~MBs/sec) that is the second-largest fixed cost after XLA
+# recompilation. The cache below makes the transfer once-per-(panel,
+# placement, dtype, padding) for the whole process, with explicit
+# invalidation. Entries are keyed by PANEL OBJECT IDENTITY (content
+# hashing a [N, T, F] array per lookup would defeat the purpose) plus
+# the mesh fingerprint — a mutated-in-place panel therefore requires an
+# explicit invalidate_panel() call, same contract as any residency
+# cache. Garbage-collected panels evict themselves (weakref.finalize),
+# so id() reuse can never alias a dead entry.
+
+_PANEL_CACHE: dict = {}
+
+
+def _panel_cache_key(panel, mesh, compute_dtype, raw, lane_pad):
+    from lfm_quant_tpu.parallel.mesh import mesh_fingerprint
+
+    return (id(panel), mesh_fingerprint(mesh),
+            jnp.dtype(compute_dtype).name if compute_dtype is not None
+            else None, bool(raw), bool(lane_pad))
+
+
+def cached_device_panel(panel: Panel, mesh=None, compute_dtype=None,
+                        raw: bool = False, lane_pad: bool = False) -> dict:
+    """:func:`device_panel` behind the per-process residency cache.
+
+    ``mesh`` replaces device_panel's raw ``sharding`` argument: the
+    placement every trainer actually wants is replicated-over-mesh (or
+    default-device when None), and taking the mesh keeps the cache key
+    well-defined (NamedShardings over equal meshes compare equal, but
+    fingerprinting the mesh directly is simpler and covers None). A hit
+    returns the SAME device arrays the previous trainer bound — zero H2D
+    traffic — and bumps ``REUSE_COUNTERS.panel_cache_hits``; a miss
+    transfers via device_panel (which bumps the transfer counters).
+    """
+    key = _panel_cache_key(panel, mesh, compute_dtype, raw, lane_pad)
+    hit = _PANEL_CACHE.get(key)
+    if hit is not None:
+        from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+        REUSE_COUNTERS.panel_cache_hits += 1
+        return hit
+    from lfm_quant_tpu.parallel.mesh import replicated
+
+    sharding = replicated(mesh) if mesh is not None else None
+    dev = device_panel(panel, sharding, compute_dtype=compute_dtype,
+                       raw=raw, lane_pad=lane_pad)
+    _PANEL_CACHE[key] = dev
+    # Evict on panel gc: entries must never outlive their panel (id()
+    # reuse would silently serve another panel's bytes).
+    weakref.finalize(panel, _PANEL_CACHE.pop, key, None)
+    return dev
+
+
+def invalidate_panel(panel: Panel) -> int:
+    """Drop every cached device copy of ``panel`` (all placements/dtypes).
+    The explicit invalidation hook for callers that mutate a panel's
+    arrays in place. Returns the number of entries dropped."""
+    doomed = [k for k in _PANEL_CACHE if k[0] == id(panel)]
+    for k in doomed:
+        _PANEL_CACHE.pop(k, None)
+    return len(doomed)
+
+
+def clear_panel_cache() -> None:
+    """Drop all cached device panels (tests / memory pressure)."""
+    _PANEL_CACHE.clear()
 
 
 def _slice_windows(rows, vrows, time_idx, window: int):

@@ -50,6 +50,12 @@ from jax.experimental.pallas import tpu as pltpu
 _LANE = 128  # TPU lane width: DMA-sliced arrays need lane-dim alignment
 _SUBLANE = 8  # month-dim tiling: DMA slice starts/extents must align to it
 
+# "Leave this operand unblocked in HBM": newer jax spells it pltpu.HBM;
+# jax 0.4.x only has the ANY memory space (TPUMemorySpace.ANY), which for
+# an unblocked input means the same thing — the kernel DMAs from it
+# manually. Resolved once here so the kernel body stays version-agnostic.
+_HBM = getattr(pltpu, "HBM", pltpu.ANY)
+
 
 def padded_months(n_months: int) -> int:
     """Month count after ``pad_months`` — the single source of truth for
@@ -134,7 +140,7 @@ def _make_gather(window: int, n_months: int, bf: int, bb: int,
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(D, bf // bb),
-            in_specs=[pl.BlockSpec(memory_space=pltpu.HBM)],
+            in_specs=[pl.BlockSpec(memory_space=_HBM)],
             out_specs=pl.BlockSpec(
                 (1, bb, w_pad, Fp), lambda d, j, fi, ti: (d, j, 0, 0),
                 memory_space=pltpu.VMEM),

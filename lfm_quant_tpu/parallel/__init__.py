@@ -7,6 +7,7 @@ from lfm_quant_tpu.parallel.mesh import (
     SEQ_AXIS,
     batch_sharding,
     make_mesh,
+    mesh_fingerprint,
     replicated,
     seed_sharding,
     shard_batch,
@@ -24,6 +25,7 @@ __all__ = [
     "DATA_AXIS",
     "SEQ_AXIS",
     "make_mesh",
+    "mesh_fingerprint",
     "replicated",
     "batch_sharding",
     "seed_sharding",

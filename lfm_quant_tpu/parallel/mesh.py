@@ -101,6 +101,43 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
     return Mesh(grid.reshape(n_seed, n_data), (SEED_AXIS, DATA_AXIS))
 
 
+def shard_map_compat(fn, *, mesh: Mesh, in_specs, out_specs,
+                     check_vma: bool = False):
+    """``jax.shard_map`` across the jax versions this repo must run on.
+
+    Newer jax exposes it as ``jax.shard_map(..., check_vma=)``; jax
+    0.4.x (the CI image) only has ``jax.experimental.shard_map`` with
+    the older ``check_rep=`` spelling of the same knob. Every shard_map
+    call site in the trainers/ring layer routes through here so the
+    whole mesh test surface runs on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def mesh_fingerprint(mesh: Optional[Mesh]):
+    """Hashable identity of a mesh for the cross-fold reuse caches
+    (train/reuse.py, data/windows.py cached_device_panel): axis names,
+    shape, and the concrete device ids. Two meshes built independently
+    over the same devices fingerprint equal — exactly the walk-forward
+    case where every fold's trainer builds its own (equal) mesh and must
+    bind the previous fold's executables and resident panel. ``None``
+    (no mesh — single device) fingerprints as the default device's id so
+    a device hot-swap cannot alias a stale panel."""
+    if mesh is None:
+        d = jax.devices()[0]
+        return (d.platform, d.id)
+    return (
+        tuple(mesh.axis_names),
+        tuple(mesh.devices.shape),
+        tuple(d.id for d in mesh.devices.flat),
+    )
+
+
 def resolve_seq_shards(requested: int, devices_left: int) -> int:
     """Degrade a requested seq-axis size to the devices actually left
     over (after the seed/data axes took theirs), warning when it shrinks

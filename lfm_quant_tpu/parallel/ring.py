@@ -124,7 +124,7 @@ def sequence_parallel_apply(model, params, x, m, mesh: Mesh,
 
     The window length must divide by the mesh axis size.
     """
-    shard_map = jax.shard_map
+    from lfm_quant_tpu.parallel.mesh import shard_map_compat as shard_map
 
     W = x.shape[-2]
     n = mesh.shape[axis_name]

@@ -57,17 +57,160 @@ from lfm_quant_tpu.utils.logging import MetricsLogger
 from lfm_quant_tpu.utils.profiling import StepTimer
 
 
+class EnsemblePrograms:
+    """Seed-vmapped twin of ``TrainerPrograms`` (train/loop.py): the
+    ensemble's jitted step/multi-step/forward wrappers, hoisted out of
+    per-instance construction into the cross-fold program cache
+    (reuse.ensemble_program_key = inner trainer key + seed-stack
+    geometry). Holds only the inner program bundle and the vmapped
+    wrappers — no panel, samplers, or TrainState — so cache entries stay
+    lightweight and fold k+1's EnsembleTrainer binds fold k's
+    executables."""
+
+    def __init__(self, inner, mesh, n_seeds: int, seed_block: int):
+        from lfm_quant_tpu.utils.profiling import count_traces
+
+        self.inner = inner  # TrainerPrograms
+        self.mesh = mesh
+        self.n_seeds = n_seeds
+        self.seed_block = seed_block
+        self._n_seq = inner._n_seq
+
+        # vmap the single-seed impls over the stacked state + index batch
+        # (device panel broadcast, in_axes=None); under a mesh, shard_map
+        # the vmapped step over (seed × data) — each shard trains its local
+        # seed block on its local dates with Pallas kernels intact, psum
+        # over 'data' only (seeds are independent).
+        if mesh is None:
+            self._vstep = jax.vmap(
+                inner._step_impl, in_axes=(0, None, 0, 0, 0))
+            self._jit_step = jax.jit(
+                count_traces("ens_step", self._step_shards))
+            self._jit_multi_step = jax.jit(
+                count_traces("ens_multi_step", self._multi_step_impl))
+        else:
+            # Batch psums cover the data axis and, when present, the seq
+            # axis (per-shard sub-window gradients sum to the full-window
+            # gradient; the loss num/den seq duplication cancels —
+            # train/loop.py _shard_mapped has the argument).
+            step_axes = ((DATA_AXIS, SEQ_AXIS) if self._n_seq > 1
+                         else (DATA_AXIS,))
+            self._vstep = jax.vmap(
+                functools.partial(inner._step_impl, axis=step_axes),
+                in_axes=(0, None, 0, 0, 0))
+            self._jit_step = jax.jit(count_traces(
+                "ens_step",
+                self._shard_mapped(self._step_shards, steps_axis=False)))
+            self._jit_multi_step = jax.jit(count_traces(
+                "ens_multi_step",
+                self._shard_mapped(self._multi_step_impl, steps_axis=True)))
+        self._jit_forward = jax.jit(count_traces(
+            "ens_forward",
+            jax.vmap(inner._forward_impl, in_axes=(0, None, None, None, None))))
+        # Heteroscedastic twin: per-seed (mean, aleatoric variance) for
+        # the uncertainty-aware aggregation (mean_minus_total_std).
+        self._jit_forward_var = jax.jit(count_traces(
+            "ens_forward_var",
+            jax.vmap(functools.partial(inner._forward_impl, variance=True),
+                     in_axes=(0, None, None, None, None))))
+
+    def _step_shards(self, state, dev, fi, ti, w):
+        """One ensemble step over the LOCAL seed stack (the whole stack
+        off-mesh; the shard's block under shard_map).
+
+        With ``seed_block`` set, the local stack is stepped in blocks via
+        ``lax.scan`` — peak activation memory drops from all-local-seeds ×
+        per-seed to seed_block × per-seed (params/opt stay resident either
+        way), which is what lets a 64-seed c5 train on a single chip when
+        the vmapped backward doesn't fit HBM. Seeds are independent, so
+        blocking is numerically a pure re-batching."""
+        blk = self.seed_block
+        s_local = fi.shape[0]
+        if not blk or blk >= s_local:
+            return self._vstep(state, dev, fi, ti, w)
+        nb = s_local // blk
+
+        def to_blocks(t):
+            return jax.tree.map(
+                lambda x: x.reshape((nb, blk) + x.shape[1:]), t)
+
+        def body(_, xs):
+            st, f, t, ww = xs
+            return None, self._vstep(st, dev, f, t, ww)
+
+        _, (new_state, ms) = jax.lax.scan(
+            body, None, (to_blocks(state), to_blocks(fi), to_blocks(ti),
+                         to_blocks(w)))
+        unblock = lambda t: jax.tree.map(
+            lambda x: x.reshape((s_local,) + x.shape[2:]), t)
+        return unblock(new_state), unblock(ms)
+
+    def _shard_mapped(self, impl, steps_axis: bool):
+        """shard_map an ensemble step over (seed × data): the stacked
+        state shards its leading seed axis; [.., S, D, Bf] index batches
+        shard seed and date axes; the panel replicates. out_specs mark the
+        state seed-sharded and (implicitly) data-replicated — true because
+        the psum'd gradients make every data-shard's update identical
+        (check_vma=False: replication is mathematical, not provable)."""
+        from jax.sharding import PartitionSpec as P
+
+        from lfm_quant_tpu.parallel.mesh import shard_map_compat
+
+        batch = (P(None, SEED_AXIS, DATA_AXIS) if steps_axis
+                 else P(SEED_AXIS, DATA_AXIS))
+        metrics = P(None, SEED_AXIS) if steps_axis else P(SEED_AXIS)
+        return shard_map_compat(
+            impl,
+            mesh=self.mesh,
+            in_specs=(P(SEED_AXIS), P(), batch, batch, batch),
+            out_specs=(P(SEED_AXIS), metrics),
+            check_vma=False,
+        )
+
+    def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w):
+        """K vmapped ensemble steps in one dispatch: lax.scan over a
+        [K, S, D, Bf] index stack (see Trainer._multi_step_impl)."""
+        def body(st, batch):
+            return self._step_shards(st, dev, *batch)
+
+        return jax.lax.scan(body, state, (fi, ti, w))
+
+
 class EnsembleTrainer:
-    """Trains ``cfg.n_seeds`` members as one vmapped, seed-sharded program."""
+    """Trains ``cfg.n_seeds`` members as one vmapped, seed-sharded
+    program. Like the single-seed Trainer, the jitted wrappers live on a
+    cached :class:`EnsemblePrograms` bundle so walk-forward folds (and
+    ``rebind``) reuse executables instead of recompiling."""
 
     def __init__(self, cfg: RunConfig, splits: PanelSplits,
                  run_dir: Optional[str] = None, echo: bool = False):
+        self._setup(cfg, splits, run_dir, echo)
+
+    def rebind(self, cfg: Optional[RunConfig] = None,
+               splits: Optional[PanelSplits] = None,
+               run_dir: Optional[str] = None,
+               echo: Optional[bool] = None) -> "EnsembleTrainer":
+        """Re-initialize for the next walk-forward fold: fresh per-seed
+        sampler orders, new split boundaries/run dir, stacked TrainState
+        dropped — without rebuilding the vmapped jit wrappers when the
+        program key is unchanged (see Trainer.rebind). Returns self."""
+        self._setup(cfg if cfg is not None else self.cfg,
+                    splits if splits is not None else self.splits,
+                    run_dir,
+                    self.echo if echo is None else echo)
+        return self
+
+    def _setup(self, cfg: RunConfig, splits: PanelSplits,
+               run_dir: Optional[str], echo: bool) -> None:
+        from lfm_quant_tpu.train import reuse
+
         if cfg.n_seeds < 2:
             raise ValueError("EnsembleTrainer needs n_seeds >= 2")
         self.cfg = cfg
         self.splits = splits
         self.run_dir = run_dir
         self.echo = echo
+        self.state = None
         self.n_seeds = cfg.n_seeds
 
         # Mesh FIRST: seed axis as large as divides both n_seeds and the
@@ -133,97 +276,35 @@ class EnsembleTrainer:
         ]
         self.val_sampler = self.inner.val_sampler
 
-        # vmap the single-seed impls over the stacked state + index batch
-        # (device panel broadcast, in_axes=None); under a mesh, shard_map
-        # the vmapped step over (seed × data) — each shard trains its local
-        # seed block on its local dates with Pallas kernels intact, psum
-        # over 'data' only (seeds are independent).
-        if self.mesh is None:
-            self._vstep = jax.vmap(
-                self.inner._step_impl, in_axes=(0, None, 0, 0, 0))
-            self._jit_step = jax.jit(self._step_shards)
-            self._jit_multi_step = jax.jit(self._multi_step_impl)
-        else:
-            # Batch psums cover the data axis and, when present, the seq
-            # axis (per-shard sub-window gradients sum to the full-window
-            # gradient; the loss num/den seq duplication cancels —
-            # train/loop.py _shard_mapped has the argument).
-            step_axes = ((DATA_AXIS, SEQ_AXIS) if self._n_seq > 1
-                         else (DATA_AXIS,))
-            self._vstep = jax.vmap(
-                functools.partial(self.inner._step_impl, axis=step_axes),
-                in_axes=(0, None, 0, 0, 0))
-            self._jit_step = jax.jit(self._shard_mapped(
-                self._step_shards, steps_axis=False))
-            self._jit_multi_step = jax.jit(self._shard_mapped(
-                self._multi_step_impl, steps_axis=True))
-        self._jit_forward = jax.jit(
-            jax.vmap(self.inner._forward_impl, in_axes=(0, None, None, None, None))
-        )
-        # Heteroscedastic twin: per-seed (mean, aleatoric variance) for
-        # the uncertainty-aware aggregation (mean_minus_total_std).
-        self._jit_forward_var = jax.jit(jax.vmap(
-            functools.partial(self.inner._forward_impl, variance=True),
-            in_axes=(0, None, None, None, None)))
+        # Vmapped/jitted wrappers through the cross-fold program cache:
+        # key = inner trainer key + seed-stack geometry. A hit binds the
+        # previous fold's executables; a changed n_seeds/seed_block (or
+        # any inner-key change) builds fresh — never stale reuse.
+        self.program_key = reuse.ensemble_program_key(
+            self.inner.program_key, self.mesh, self.n_seeds,
+            self.seed_block)
+        self.programs = reuse.get_programs(
+            self.program_key,
+            lambda: EnsemblePrograms(self.inner.programs, self.mesh,
+                                     self.n_seeds, self.seed_block))
+        p = self.programs
+        self.mesh = p.mesh  # canonical (donor's; compares equal)
+        self._jit_step = p._jit_step
+        self._jit_multi_step = p._jit_multi_step
+        self._jit_forward = p._jit_forward
+        self._jit_forward_var = p._jit_forward_var
 
-    def _step_shards(self, state, dev, fi, ti, w):
-        """One ensemble step over the LOCAL seed stack (the whole stack
-        off-mesh; the shard's block under shard_map).
+    # ---- program delegates (back-compat; see Trainer's) --------------
 
-        With ``seed_block`` set, the local stack is stepped in blocks via
-        ``lax.scan`` — peak activation memory drops from all-local-seeds ×
-        per-seed to seed_block × per-seed (params/opt stay resident either
-        way), which is what lets a 64-seed c5 train on a single chip when
-        the vmapped backward doesn't fit HBM. Seeds are independent, so
-        blocking is numerically a pure re-batching."""
-        blk = self.seed_block
-        s_local = fi.shape[0]
-        if not blk or blk >= s_local:
-            return self._vstep(state, dev, fi, ti, w)
-        nb = s_local // blk
+    @property
+    def _vstep(self):
+        return self.programs._vstep
 
-        def to_blocks(t):
-            return jax.tree.map(
-                lambda x: x.reshape((nb, blk) + x.shape[1:]), t)
+    def _step_shards(self, *args, **kwargs):
+        return self.programs._step_shards(*args, **kwargs)
 
-        def body(_, xs):
-            st, f, t, ww = xs
-            return None, self._vstep(st, dev, f, t, ww)
-
-        _, (new_state, ms) = jax.lax.scan(
-            body, None, (to_blocks(state), to_blocks(fi), to_blocks(ti),
-                         to_blocks(w)))
-        unblock = lambda t: jax.tree.map(
-            lambda x: x.reshape((s_local,) + x.shape[2:]), t)
-        return unblock(new_state), unblock(ms)
-
-    def _shard_mapped(self, impl, steps_axis: bool):
-        """shard_map an ensemble step over (seed × data): the stacked
-        state shards its leading seed axis; [.., S, D, Bf] index batches
-        shard seed and date axes; the panel replicates. out_specs mark the
-        state seed-sharded and (implicitly) data-replicated — true because
-        the psum'd gradients make every data-shard's update identical
-        (check_vma=False: replication is mathematical, not provable)."""
-        from jax.sharding import PartitionSpec as P
-
-        batch = (P(None, SEED_AXIS, DATA_AXIS) if steps_axis
-                 else P(SEED_AXIS, DATA_AXIS))
-        metrics = P(None, SEED_AXIS) if steps_axis else P(SEED_AXIS)
-        return jax.shard_map(
-            impl,
-            mesh=self.mesh,
-            in_specs=(P(SEED_AXIS), P(), batch, batch, batch),
-            out_specs=(P(SEED_AXIS), metrics),
-            check_vma=False,
-        )
-
-    def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w):
-        """K vmapped ensemble steps in one dispatch: lax.scan over a
-        [K, S, D, Bf] index stack (see Trainer._multi_step_impl)."""
-        def body(st, batch):
-            return self._step_shards(st, dev, *batch)
-
-        return jax.lax.scan(body, state, (fi, ti, w))
+    def _multi_step_impl(self, *args, **kwargs):
+        return self.programs._multi_step_impl(*args, **kwargs)
 
     # ---- state -------------------------------------------------------
 
@@ -412,7 +493,8 @@ def run_ensemble_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
     dates = panel.dates
     train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
     val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
-    splits = PanelSplits.by_date(panel, train_end, val_end)
+    splits = PanelSplits.by_date(panel, train_end, val_end,
+                                 train_start=d.train_start)
 
     run_dir = os.path.join(cfg.out_dir, cfg.name, "ensemble")
     trainer = EnsembleTrainer(cfg, splits, run_dir=run_dir, echo=echo)
@@ -443,7 +525,8 @@ def load_ensemble(run_dir: str, panel: Optional[Panel] = None):
     dates = panel.dates
     train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
     val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
-    splits = PanelSplits.by_date(panel, train_end, val_end)
+    splits = PanelSplits.by_date(panel, train_end, val_end,
+                                 train_start=d.train_start)
     trainer = EnsembleTrainer(cfg, splits, run_dir=run_dir)
     state = trainer.init_state()
     ckpt = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))

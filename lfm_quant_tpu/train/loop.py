@@ -31,7 +31,6 @@ from lfm_quant_tpu.data.panel import Panel, PanelSplits
 from lfm_quant_tpu.data.windows import (
     DateBatchSampler,
     WindowIndex,
-    device_panel,
     gather_targets,
     gather_windows_packed,
     resolve_gather_impl,
@@ -236,91 +235,43 @@ class FitHarness:
         return best
 
 
-class Trainer:
-    """Single-seed trainer: fit on splits.train, early-stop on splits.val.
+class TrainerPrograms:
+    """The trace-relevant core of a Trainer: models, optimizer, and the
+    jitted step/multi-step/forward/eval wrappers — hoisted out of
+    per-instance construction into the module-level program cache
+    (train/reuse.py) so a walk-forward sweep binds ONE set of
+    executables across folds.
 
-    The ensemble trainer (train/ensemble.py) reuses the same jitted step
-    vmapped over a leading seed axis.
+    Everything held here is a pure function of the cache key
+    (``reuse.trainer_program_key``); nothing per-fold lives here — the
+    panel, splits, samplers, run dir and TrainState all stay on the
+    Trainer. That is the invariant that makes sharing safe: two
+    Trainers with equal keys would have built byte-identical programs,
+    so binding the first one's wrappers changes nothing but the compile
+    count. Deliberately lightweight (no panel/device arrays) so cache
+    entries never pin folds' worth of HBM or host memory.
     """
 
-    def __init__(self, cfg: RunConfig, splits: PanelSplits,
-                 run_dir: Optional[str] = None, echo: bool = False,
-                 mesh: Any = "auto"):
-        """``mesh``: "auto" builds the single-seed (1 × n_data_shards)
-        data mesh; wrappers pass their own mesh (EnsembleTrainer's
-        seed × data) or None, so model/gather/panel resolution happens
-        exactly once against the mesh that will actually run the step
-        (the ensemble then shares this trainer's device panel).
-        """
-        self.cfg = cfg
-        self.splits = splits
-        self.run_dir = run_dir
-        self.echo = echo
-        d = cfg.data
+    def __init__(self, cfg: RunConfig, mesh: Any, n_seq: int,
+                 steps_per_epoch: int, gather_impl: str,
+                 eval_gather_impl: str, eval_gather_sharded: str, fp: int):
+        from lfm_quant_tpu.utils.profiling import count_traces
 
+        self.cfg = cfg
+        self.mesh = mesh
+        self.window = cfg.data.window
+        self._n_seq = n_seq
+        self._gather_impl = gather_impl
+        self._eval_gather_impl = eval_gather_impl
+        self._eval_gather_sharded = eval_gather_sharded
+        self._fp = fp
         self.loss_fn = make_loss_fn(cfg.optim.loss)
         self.loss_parts = make_loss_parts(cfg.optim.loss)
-        self.window = d.window
         # Stochastic-regularization flag: when dropout is configured, the
         # train step threads a per-step rng + deterministic=False through
         # model.apply (eval stays deterministic). Without it the rng plumb
         # is skipped entirely, keeping the jitted graph unchanged.
         self._needs_rng = float(cfg.model.kwargs.get("dropout") or 0.0) > 0.0
-
-        # Data-parallel mesh (SURVEY.md §8 step 8): shard the DATE axis of
-        # each batch so monthly cross-sections stay shard-local for
-        # rank-IC. With ``n_seq_shards > 1`` the mesh gains an innermost
-        # 'seq' axis — sequence/context parallelism for the train forward
-        # (ring attention for the transformer, distributed associative
-        # scan for the LRU); the two compose: batches shard dates over
-        # 'data' and replicate over 'seq', where each shard runs its
-        # window slice. Both axes degrade gracefully to fewer devices
-        # than configured (data first — it reduces step memory; a
-        # pod-trained config must stay loadable for eval/backtest on a
-        # smaller host, where only the full-window eval model runs).
-        self._n_seq = 1
-        if mesh == "auto":
-            n_data = max(1, min(cfg.n_data_shards, jax.device_count()))
-            if cfg.n_seq_shards > 1:
-                if self._needs_rng:
-                    raise ValueError(
-                        "dropout is unsupported under sequence parallelism "
-                        "(shard-local masks would decorrelate; see "
-                        "models/transformer.py)")
-                from lfm_quant_tpu.parallel.mesh import resolve_seq_shards
-
-                self._n_seq = resolve_seq_shards(
-                    cfg.n_seq_shards, jax.device_count() // n_data)
-                if self._n_seq > 1 and d.window % self._n_seq:
-                    raise ValueError(
-                        f"window={d.window} must divide by "
-                        f"n_seq_shards={self._n_seq}")
-            mesh = (make_mesh(1, n_data, n_seq=self._n_seq)
-                    if n_data * self._n_seq > 1 else None)
-        elif cfg.n_seq_shards > 1:
-            # Wrapper-provided mesh (EnsembleTrainer): the wrapper owns
-            # degradation and axis sizing — a mesh WITHOUT a seq axis (or
-            # no mesh at all, e.g. eval on a small host) means seq
-            # degraded to 1: train/eval with the plain full-window model.
-            if mesh is not None and SEQ_AXIS in mesh.shape:
-                if self._needs_rng:
-                    raise ValueError(
-                        "dropout is unsupported under sequence "
-                        "parallelism (shard-local masks would "
-                        "decorrelate; see models/transformer.py)")
-                self._n_seq = mesh.shape[SEQ_AXIS]
-                if self._n_seq > 1 and d.window % self._n_seq:
-                    raise ValueError(
-                        f"window={d.window} must divide by "
-                        f"n_seq_shards={self._n_seq}")
-        self.mesh = mesh
-        # Test/introspection alias: the mesh carrying the live seq axis.
-        self.seq_mesh = mesh if self._n_seq > 1 else None
-        n_data = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
-        if d.dates_per_batch % n_data:
-            raise ValueError(
-                f"dates_per_batch={d.dates_per_batch} must be divisible by "
-                f"n_data_shards={n_data}")
 
         # Train model: the Pallas fused recurrence survives the mesh
         # because the train step runs inside shard_map (locally
@@ -330,74 +281,14 @@ class Trainer:
         # (models/rnn.py _GateKernel path aliasing), so params interchange.
         # Under sequence parallelism the train model is the seq_axis-aware
         # variant (checkpoint-compatible: no per-position params).
-        kind, kwargs = model_kwargs(cfg, seq_axis=self._n_seq > 1)
+        kind, kwargs = model_kwargs(cfg, seq_axis=n_seq > 1)
         self.model = build_model(kind, **kwargs)
-        if self.mesh is not None:
+        if mesh is not None:
             ekind, ekwargs = model_kwargs(cfg, force_xla_scan=True)
             self.eval_model = build_model(ekind, **ekwargs)
         else:
             self.eval_model = self.model
 
-        self.train_sampler = DateBatchSampler(
-            splits.panel, d.window, d.dates_per_batch, d.firms_per_date,
-            seed=cfg.seed, min_valid_months=d.min_valid_months,
-            date_range=splits.train_range, engine=d.sampler_engine,
-        )
-        self.val_sampler = DateBatchSampler(
-            splits.panel, d.window, 1, d.firms_per_date,
-            seed=cfg.seed, min_valid_months=d.min_valid_months,
-            min_cross_section=1, date_range=splits.val_range,
-        )
-        # Gather implementation (Pallas DMA gather needs a lane-padded
-        # panel, so it must be resolved before the device transfer). Under
-        # a mesh the eval sweep keeps the XLA gather even though the
-        # month-sharded path (_forward_eval) does run inside shard_map
-        # where a pallas_call would be legal: the MC-dropout path still
-        # runs un-sharded (GSPMD), and one shared eval gather impl keeps
-        # the paths identical.
-        self._gather_impl = resolve_gather_impl(
-            d.gather_impl, self.mesh, splits.panel, d.window,
-            bf16=cfg.model.bf16)
-        if self._n_seq > 1:
-            # Sequence-parallel steps gather only the shard's SUB-window
-            # (window // n_seq months) — the Pallas DMA gather's aligned
-            # spans are validated for the full window only, so the train
-            # gather takes the XLA path under a seq axis.
-            self._gather_impl = "xla"
-        # Eval defaults to the XLA gather even where the DMA gather is
-        # legal: the on-chip A/B (BENCH_ROWS.jsonl, 2026-07-31, c2) put
-        # the XLA-gather eval at 48.0M fm/s vs 33.4M for the DMA gather
-        # (+44% — the full-cross-section sweep is gather-bound in a way
-        # the train step is not), and the XLA rows were measured LATER
-        # in the session, so tunnel-state drift biases against them.
-        # An EXPLICIT gather_impl="pallas" config still carries into
-        # single-chip eval (the A/B override path); "auto" never does.
-        self._eval_gather_impl = (
-            self._gather_impl
-            if d.gather_impl == "pallas" and self.mesh is None else "xla")
-        # Sharded-eval gather promotion, flag-gated: inside the
-        # month-sharded shard_map each shard is locally un-partitioned,
-        # so the DMA gather is as legal there as in the train step.
-        # LFM_EVAL_SHARDED_GATHER=pallas opts the sharded dispatches
-        # (axis != None in _forward_impl) into it when the panel is
-        # already lane-padded for the train gather; the GSPMD paths
-        # (MC-dropout sampling, no-mesh eval) are untouched. The c2 A/B
-        # above makes this promotion unlikely to pay — kept for the
-        # mesh-resident re-measurement.
-        self._eval_gather_sharded = self._eval_gather_impl
-        if (os.environ.get("LFM_EVAL_SHARDED_GATHER") == "pallas"
-                and self._gather_impl == "pallas"):
-            self._eval_gather_sharded = "pallas"
-        self._fp = splits.panel.n_features + 1  # logical packed width
-        # ONE device-resident copy of the full panel serves training,
-        # eval and inference (PanelSplits are anchor ranges, not slices).
-        panel_sharding = replicated(self.mesh) if self.mesh else None
-        self.dev = device_panel(
-            splits.panel, panel_sharding,
-            compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
-            raw=False, lane_pad=self._gather_impl == "pallas")
-
-        steps_per_epoch = self.train_sampler.batches_per_epoch()
         total_steps = max(1, steps_per_epoch * cfg.optim.epochs)
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, cfg.optim.lr, min(cfg.optim.warmup_steps, total_steps // 2),
@@ -418,40 +309,46 @@ class Trainer:
         self.tx = optax.chain(
             optax.clip_by_global_norm(cfg.optim.grad_clip), opt)
 
-        if self.mesh is None:
-            self._jit_step = jax.jit(self._step_impl)
-            self._jit_multi_step = jax.jit(self._multi_step_impl)
+        if mesh is None:
+            self._jit_step = jax.jit(count_traces("step", self._step_impl))
+            self._jit_multi_step = jax.jit(
+                count_traces("multi_step", self._multi_step_impl))
         else:
             # shard_map over the date axis: each shard gathers and runs the
             # model locally (Pallas kernels legal), with explicit psums for
             # the global loss/gradients — numerically the same weighted
             # means GSPMD computed, up to reduction order.
-            self._jit_step = jax.jit(self._shard_mapped(
-                self._step_impl, steps_axis=False))
-            self._jit_multi_step = jax.jit(self._shard_mapped(
-                self._multi_step_impl, steps_axis=True))
-        self._jit_forward = jax.jit(self._forward_impl,
-                                    static_argnames=("variance",))
+            self._jit_step = jax.jit(count_traces("step", self._shard_mapped(
+                self._step_impl, steps_axis=False)))
+            self._jit_multi_step = jax.jit(count_traces(
+                "multi_step",
+                self._shard_mapped(self._multi_step_impl, steps_axis=True)))
+        self._jit_forward = jax.jit(
+            count_traces("forward", self._forward_impl),
+            static_argnames=("variance",))
         # Month-sharded eval: under a data mesh the plain jitted forward
         # would replicate the whole sweep on every device; shard_map over
         # the stacked month axis makes eval/backtest scale with the data
         # axis like training does (n_data× at pod scale). MC-dropout
         # sampling keeps the plain path (per-chunk rng keys don't shard).
-        self._eval_sharded = (self.mesh is not None
-                              and self.mesh.shape[DATA_AXIS] > 1)
+        self._eval_sharded = (mesh is not None
+                              and mesh.shape[DATA_AXIS] > 1)
+        self._jit_fwd_det = self._jit_fwd_var = None
         if self._eval_sharded:
             import functools
 
             from jax.sharding import PartitionSpec as P
 
+            from lfm_quant_tpu.parallel.mesh import shard_map_compat
+
             sharded = functools.partial(
-                jax.shard_map, mesh=self.mesh,
+                shard_map_compat, mesh=mesh,
                 in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS),
                           P(DATA_AXIS)),
                 check_vma=False)
-            self._jit_fwd_det = jax.jit(sharded(
+            self._jit_fwd_det = jax.jit(count_traces("fwd_det", sharded(
                 functools.partial(self._forward_impl, axis=DATA_AXIS),
-                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P())))
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P()))))
 
             def fwd_var(params, dev, fi, ti, w):
                 # axis marks this as a SHARDED dispatch (gather promotion
@@ -462,11 +359,11 @@ class Trainer:
                                                   axis=DATA_AXIS)
                 return mean, var
 
-            self._jit_fwd_var = jax.jit(sharded(
-                fwd_var, out_specs=(P(DATA_AXIS), P(DATA_AXIS))))
+            self._jit_fwd_var = jax.jit(count_traces("fwd_var", sharded(
+                fwd_var, out_specs=(P(DATA_AXIS), P(DATA_AXIS)))))
 
     def _shard_mapped(self, impl, steps_axis: bool):
-        """Wrap a step impl in shard_map over this trainer's mesh.
+        """Wrap a step impl in shard_map over this program set's mesh.
 
         State and panel replicate (P()); index batches shard their date
         axis (and replicate over the seq axis when present — every seq
@@ -481,9 +378,11 @@ class Trainer:
 
         from jax.sharding import PartitionSpec as P
 
+        from lfm_quant_tpu.parallel.mesh import shard_map_compat
+
         axes = ((DATA_AXIS, SEQ_AXIS) if self._n_seq > 1 else (DATA_AXIS,))
         batch = P(None, DATA_AXIS) if steps_axis else P(DATA_AXIS)
-        return jax.shard_map(
+        return shard_map_compat(
             functools.partial(impl, axis=axes),
             mesh=self.mesh,
             in_specs=(P(), P(), batch, batch, batch),
@@ -612,12 +511,12 @@ class Trainer:
         T/W × the window bytes for every eval month at once.
 
         ``rng`` switches dropout LIVE (per-chunk keys) — the MC-dropout
-        sampling path of :meth:`predict`; None is the deterministic eval.
-        ``variance`` (static) returns (mean, aleatoric variance, None)
-        from a heteroscedastic head instead of (pred, IC, mse) — the
-        uncertainty-aware-LFM prediction path (SURVEY.md §1 lineage).
-        ``axis``: mesh axis name when running inside the month-sharded
-        eval ``shard_map`` (see ``_forward_eval``) — the mse parts psum
+        sampling path of :meth:`Trainer.predict`; None is the
+        deterministic eval. ``variance`` (static) returns (mean, aleatoric
+        variance, None) from a heteroscedastic head instead of
+        (pred, IC, mse) — the uncertainty-aware-LFM prediction path
+        (SURVEY.md §1 lineage). ``axis``: mesh axis name when running
+        inside the month-sharded eval ``shard_map`` — the mse parts psum
         over it so the scalar replicates.
         """
         if variance and rng is not None:
@@ -678,6 +577,235 @@ class Trainer:
             ws_sum = jax.lax.psum(ws_sum, axis)
         mse = se_sum / jnp.maximum(ws_sum, 1e-12)
         return pred, ic, mse
+
+
+class Trainer:
+    """Single-seed trainer: fit on splits.train, early-stop on splits.val.
+
+    The ensemble trainer (train/ensemble.py) reuses the same jitted step
+    vmapped over a leading seed axis. The jitted programs themselves live
+    on a :class:`TrainerPrograms` bundle fetched through the cross-fold
+    program cache (train/reuse.py) — two trainers with equal program
+    keys (same mesh/model/optimizer/gather geometry) share executables,
+    which is what makes a walk-forward sweep compile once.
+    """
+
+    def __init__(self, cfg: RunConfig, splits: PanelSplits,
+                 run_dir: Optional[str] = None, echo: bool = False,
+                 mesh: Any = "auto"):
+        """``mesh``: "auto" builds the single-seed (1 × n_data_shards)
+        data mesh; wrappers pass their own mesh (EnsembleTrainer's
+        seed × data) or None, so model/gather/panel resolution happens
+        exactly once against the mesh that will actually run the step
+        (the ensemble then shares this trainer's device panel).
+        """
+        self._setup(cfg, splits, run_dir, echo, mesh)
+
+    def rebind(self, cfg: Optional[RunConfig] = None,
+               splits: Optional[PanelSplits] = None,
+               run_dir: Optional[str] = None,
+               echo: Optional[bool] = None) -> "Trainer":
+        """Re-initialize this trainer for the next walk-forward fold:
+        fresh sampler seeds and split boundaries, new run dir, TrainState
+        dropped — WITHOUT rebuilding the jit wrappers (the program key is
+        recomputed; an unchanged key keeps the exact same executables and
+        device panel, a changed one fetches/builds through the cache like
+        a fresh construction would). Returns self."""
+        self._setup(cfg if cfg is not None else self.cfg,
+                    splits if splits is not None else self.splits,
+                    run_dir,
+                    self.echo if echo is None else echo,
+                    "auto")
+        return self
+
+    def _setup(self, cfg: RunConfig, splits: PanelSplits,
+               run_dir: Optional[str], echo: bool, mesh: Any) -> None:
+        from lfm_quant_tpu.data.windows import cached_device_panel
+        from lfm_quant_tpu.train import reuse
+
+        self.cfg = cfg
+        self.splits = splits
+        self.run_dir = run_dir
+        self.echo = echo
+        self.state = None
+        d = cfg.data
+
+        self.window = d.window
+        # Recomputed here (not just in TrainerPrograms) because the mesh
+        # validation below needs it before any program-cache lookup.
+        self._needs_rng = float(cfg.model.kwargs.get("dropout") or 0.0) > 0.0
+
+        # Data-parallel mesh (SURVEY.md §8 step 8): shard the DATE axis of
+        # each batch so monthly cross-sections stay shard-local for
+        # rank-IC. With ``n_seq_shards > 1`` the mesh gains an innermost
+        # 'seq' axis — sequence/context parallelism for the train forward
+        # (ring attention for the transformer, distributed associative
+        # scan for the LRU); the two compose: batches shard dates over
+        # 'data' and replicate over 'seq', where each shard runs its
+        # window slice. Both axes degrade gracefully to fewer devices
+        # than configured (data first — it reduces step memory; a
+        # pod-trained config must stay loadable for eval/backtest on a
+        # smaller host, where only the full-window eval model runs).
+        self._n_seq = 1
+        if mesh == "auto":
+            n_data = max(1, min(cfg.n_data_shards, jax.device_count()))
+            if cfg.n_seq_shards > 1:
+                if self._needs_rng:
+                    raise ValueError(
+                        "dropout is unsupported under sequence parallelism "
+                        "(shard-local masks would decorrelate; see "
+                        "models/transformer.py)")
+                from lfm_quant_tpu.parallel.mesh import resolve_seq_shards
+
+                self._n_seq = resolve_seq_shards(
+                    cfg.n_seq_shards, jax.device_count() // n_data)
+                if self._n_seq > 1 and d.window % self._n_seq:
+                    raise ValueError(
+                        f"window={d.window} must divide by "
+                        f"n_seq_shards={self._n_seq}")
+            mesh = (make_mesh(1, n_data, n_seq=self._n_seq)
+                    if n_data * self._n_seq > 1 else None)
+        elif cfg.n_seq_shards > 1:
+            # Wrapper-provided mesh (EnsembleTrainer): the wrapper owns
+            # degradation and axis sizing — a mesh WITHOUT a seq axis (or
+            # no mesh at all, e.g. eval on a small host) means seq
+            # degraded to 1: train/eval with the plain full-window model.
+            if mesh is not None and SEQ_AXIS in mesh.shape:
+                if self._needs_rng:
+                    raise ValueError(
+                        "dropout is unsupported under sequence "
+                        "parallelism (shard-local masks would "
+                        "decorrelate; see models/transformer.py)")
+                self._n_seq = mesh.shape[SEQ_AXIS]
+                if self._n_seq > 1 and d.window % self._n_seq:
+                    raise ValueError(
+                        f"window={d.window} must divide by "
+                        f"n_seq_shards={self._n_seq}")
+        self.mesh = mesh
+        # Test/introspection alias: the mesh carrying the live seq axis.
+        self.seq_mesh = mesh if self._n_seq > 1 else None
+        n_data = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
+        if d.dates_per_batch % n_data:
+            raise ValueError(
+                f"dates_per_batch={d.dates_per_batch} must be divisible by "
+                f"n_data_shards={n_data}")
+
+        self.train_sampler = DateBatchSampler(
+            splits.panel, d.window, d.dates_per_batch, d.firms_per_date,
+            seed=cfg.seed, min_valid_months=d.min_valid_months,
+            date_range=splits.train_range, engine=d.sampler_engine,
+        )
+        self.val_sampler = DateBatchSampler(
+            splits.panel, d.window, 1, d.firms_per_date,
+            seed=cfg.seed, min_valid_months=d.min_valid_months,
+            min_cross_section=1, date_range=splits.val_range,
+        )
+        # Gather implementation (Pallas DMA gather needs a lane-padded
+        # panel, so it must be resolved before the device transfer). Under
+        # a mesh the eval sweep keeps the XLA gather even though the
+        # month-sharded path (_forward_eval) does run inside shard_map
+        # where a pallas_call would be legal: the MC-dropout path still
+        # runs un-sharded (GSPMD), and one shared eval gather impl keeps
+        # the paths identical.
+        self._gather_impl = resolve_gather_impl(
+            d.gather_impl, self.mesh, splits.panel, d.window,
+            bf16=cfg.model.bf16)
+        if self._n_seq > 1:
+            # Sequence-parallel steps gather only the shard's SUB-window
+            # (window // n_seq months) — the Pallas DMA gather's aligned
+            # spans are validated for the full window only, so the train
+            # gather takes the XLA path under a seq axis.
+            self._gather_impl = "xla"
+        # Eval defaults to the XLA gather even where the DMA gather is
+        # legal: the on-chip A/B (BENCH_ROWS.jsonl, 2026-07-31, c2) put
+        # the XLA-gather eval at 48.0M fm/s vs 33.4M for the DMA gather
+        # (+44% — the full-cross-section sweep is gather-bound in a way
+        # the train step is not), and the XLA rows were measured LATER
+        # in the session, so tunnel-state drift biases against them.
+        # An EXPLICIT gather_impl="pallas" config still carries into
+        # single-chip eval (the A/B override path); "auto" never does.
+        self._eval_gather_impl = (
+            self._gather_impl
+            if d.gather_impl == "pallas" and self.mesh is None else "xla")
+        # Sharded-eval gather promotion, flag-gated: inside the
+        # month-sharded shard_map each shard is locally un-partitioned,
+        # so the DMA gather is as legal there as in the train step.
+        # LFM_EVAL_SHARDED_GATHER=pallas opts the sharded dispatches
+        # (axis != None in _forward_impl) into it when the panel is
+        # already lane-padded for the train gather; the GSPMD paths
+        # (MC-dropout sampling, no-mesh eval) are untouched. The c2 A/B
+        # above makes this promotion unlikely to pay — kept for the
+        # mesh-resident re-measurement.
+        self._eval_gather_sharded = self._eval_gather_impl
+        if (os.environ.get("LFM_EVAL_SHARDED_GATHER") == "pallas"
+                and self._gather_impl == "pallas"):
+            self._eval_gather_sharded = "pallas"
+        self._fp = splits.panel.n_features + 1  # logical packed width
+        # ONE device-resident copy of the full panel serves training,
+        # eval and inference (PanelSplits are anchor ranges, not slices)
+        # — AND, through the residency cache, every other trainer/fold
+        # bound to the same (panel, mesh, dtype, padding): a walk-forward
+        # sweep transfers the panel exactly once.
+        self.dev = cached_device_panel(
+            splits.panel, self.mesh,
+            compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
+            raw=False, lane_pad=self._gather_impl == "pallas")
+
+        # Cold-process reuse: point XLA's persistent compilation cache at
+        # the configured directory (no-op when unset). Idempotent, and
+        # it must run before the first dispatch compiles.
+        reuse.enable_persistent_cache(cfg.compilation_cache_dir)
+
+        # Compiled-program bundle through the cross-fold cache: an equal
+        # key binds a previous trainer's jit wrappers (zero re-tracing
+        # for same-shape dispatches), a changed key builds fresh ones.
+        steps_per_epoch = self.train_sampler.batches_per_epoch()
+        self.program_key = reuse.trainer_program_key(
+            cfg, self.mesh, self._n_seq, self._gather_impl,
+            self._eval_gather_impl, self._eval_gather_sharded, self._fp,
+            steps_per_epoch)
+        self.programs = reuse.get_programs(
+            self.program_key,
+            lambda: TrainerPrograms(
+                cfg, self.mesh, self._n_seq, steps_per_epoch,
+                self._gather_impl, self._eval_gather_impl,
+                self._eval_gather_sharded, self._fp))
+        p = self.programs
+        # Bind the bundle's objects (for a cache hit these are the donor
+        # trainer's — byte-identical programs by key construction). The
+        # donor's mesh becomes canonical so every consumer (batch
+        # sharding, state commit, the compiled executables) agrees on
+        # one object; it compares equal to the locally-resolved mesh.
+        self.mesh = p.mesh
+        self.seq_mesh = p.mesh if self._n_seq > 1 else None
+        self.model, self.eval_model, self.tx = p.model, p.eval_model, p.tx
+        self.loss_fn, self.loss_parts = p.loss_fn, p.loss_parts
+        self._eval_sharded = p._eval_sharded
+        self._jit_step = p._jit_step
+        self._jit_multi_step = p._jit_multi_step
+        self._jit_forward = p._jit_forward
+        self._jit_fwd_det = p._jit_fwd_det
+        self._jit_fwd_var = p._jit_fwd_var
+
+    # ---- program delegates -------------------------------------------
+    # The un-jitted impls live on TrainerPrograms; these delegates keep
+    # the historical Trainer surface (tests and EnsembleTrainer vmap
+    # them) pointing at the shared bundle.
+
+    def _apply(self, *args, **kwargs):
+        return self.programs._apply(*args, **kwargs)
+
+    def _gather(self, *args, **kwargs):
+        return self.programs._gather(*args, **kwargs)
+
+    def _step_impl(self, *args, **kwargs):
+        return self.programs._step_impl(*args, **kwargs)
+
+    def _multi_step_impl(self, *args, **kwargs):
+        return self.programs._multi_step_impl(*args, **kwargs)
+
+    def _forward_impl(self, *args, **kwargs):
+        return self.programs._forward_impl(*args, **kwargs)
 
     # ---- public API --------------------------------------------------
 
@@ -969,7 +1097,8 @@ def run_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
     dates = panel.dates
     train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
     val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
-    splits = PanelSplits.by_date(panel, train_end, val_end)
+    splits = PanelSplits.by_date(panel, train_end, val_end,
+                                 train_start=d.train_start)
 
     run_dir = os.path.join(cfg.out_dir, cfg.name, f"seed{cfg.seed}")
     trainer = Trainer(cfg, splits, run_dir=run_dir, echo=echo)
@@ -996,7 +1125,8 @@ def load_trainer(run_dir: str, panel: Optional[Panel] = None):
     dates = panel.dates
     train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
     val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
-    splits = PanelSplits.by_date(panel, train_end, val_end)
+    splits = PanelSplits.by_date(panel, train_end, val_end,
+                                 train_start=d.train_start)
     trainer = Trainer(cfg, splits, run_dir=run_dir)
     state = trainer.init_state()
     ckpt = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))

@@ -1,0 +1,212 @@
+"""Cross-fold reuse layer: compile once, transfer once, retrain many.
+
+The walk-forward protocol (train/walkforward.py) used to construct a
+fresh ``Trainer``/``EnsembleTrainer`` per fold, and every construction
+re-built the jitted step/multi-step/forward wrappers and re-transferred
+the HBM-resident panel. For a ~15-fold sweep over the 1970–2024 panel
+that is ~15× XLA compilation and ~15× panel H2D for byte-identical
+same-shape programs — pure fixed cost, the amortization argument of
+PAPERS.md's "Large-Batch Training for LSTM and Beyond" applied to a
+retraining campaign instead of a single run.
+
+Three layers of reuse, outermost first:
+
+1. **Compiled-program cache** (this module): ``TrainerPrograms`` /
+   ``EnsemblePrograms`` (train/loop.py, train/ensemble.py) bundle every
+   trace-relevant object — models, optimizer, jitted wrappers — and are
+   cached here under a key covering everything that can change the
+   traced program OR its numerics: mesh fingerprint, resolved model
+   kwargs (scan impl, bf16, heteroscedastic, dropout), optimizer/
+   schedule constants (including ``steps_per_epoch`` — the LR schedule
+   bakes ``total_steps`` in as a constant), loss, resolved gather impls,
+   packed panel width, window geometry, and backend. Fold k+1 with an
+   equal key binds fold k's jit wrappers, so same-shape dispatches hit
+   jit's executable cache: zero re-tracing, zero XLA recompilation.
+   A key MISMATCH (changed model config, n_seeds, fold-varying
+   steps_per_epoch, …) builds fresh programs — there is no partial or
+   stale reuse by construction.
+2. **Device-panel residency** (data/windows.py ``cached_device_panel``):
+   one H2D transfer per (panel, mesh, dtype, padding) per process, with
+   explicit invalidation.
+3. **JAX persistent compilation cache** (:func:`enable_persistent_cache`):
+   even a cold process skips XLA re-optimization for programs any prior
+   process compiled, keyed by JAX on the serialized HLO. Config knob
+   ``RunConfig.compilation_cache_dir`` with the ``LFM_COMPILATION_CACHE``
+   env fallback.
+
+Everything is measured, not asserted: cache hits/misses, jit traces and
+panel transfers all bump ``utils/profiling.py`` ``REUSE_COUNTERS``,
+which walk-forward surfaces per fold and ``bench.py walkforward_reuse``
+turns into a ledger metric.
+
+Known limit (documented, not hidden): an expanding-window sweep whose
+eligible-date count grows enough to change ``steps_per_epoch`` changes
+the LR-schedule constants, so those folds correctly miss the cache (the
+alternative — reusing fold 1's schedule — would silently change
+numerics). Same-shape folds, the common toy/bench case and any rolling-
+window protocol, reuse fully.
+
+``LFM_PROGRAM_REUSE=0`` disables the program cache (every trainer builds
+fresh wrappers) — the A/B switch the numerical-identity tests use.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+_PROGRAM_CACHE: Dict[Tuple, Any] = {}
+
+# LRU bound on cached program bundles. A walk-forward sweep needs 1–2
+# live keys (trainer + ensemble); the cap covers a handful of coexisting
+# geometries (e.g. an expanding-window sweep drifting across
+# dates_per_batch boundaries, or an A/B of model configs) while keeping
+# the cache from pinning every bundle a long-lived process ever built —
+# each entry holds models, optax chains and jit wrappers whose
+# executable caches hold compiled programs. Evicted bundles keep working
+# for trainers already bound to them (they hold their own references);
+# only the NEXT construction with that key rebuilds.
+_PROGRAM_CACHE_SIZE = max(1, int(os.environ.get("LFM_PROGRAM_CACHE_SIZE",
+                                                "8")))
+
+
+def reuse_enabled() -> bool:
+    """Program-cache kill switch: ``LFM_PROGRAM_REUSE=0`` forces every
+    trainer to build fresh jit wrappers (the pre-reuse serial path)."""
+    return os.environ.get("LFM_PROGRAM_REUSE", "1") != "0"
+
+
+def freeze(obj):
+    """Recursively convert ``obj`` into a hashable cache-key component
+    (dicts → sorted item tuples, lists/tuples → tuples)."""
+    if isinstance(obj, dict):
+        return tuple(sorted((k, freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(freeze(v) for v in obj)
+    if isinstance(obj, set):
+        return frozenset(freeze(v) for v in obj)
+    hash(obj)  # fail loudly on an unhashable leaf, not deep in dict ops
+    return obj
+
+
+def trainer_program_key(cfg, mesh, n_seq: int, gather_impl: str,
+                        eval_gather_impl: str, eval_gather_sharded: str,
+                        fp: int, steps_per_epoch: int) -> Tuple:
+    """Cache key for a single-seed trainer's compiled programs.
+
+    Covers every input that reaches a traced program as a constant or
+    changes which program gets built. Anything arriving as a jit
+    ARGUMENT (panel arrays, index batches, TrainState) is deliberately
+    absent — jit's own executable cache keys on those avals, so a shape
+    change re-traces without any staleness risk here. Per-fold knobs
+    that must NOT trigger recompilation (seed, run name/dir, split
+    boundaries) are equally absent — that absence IS the reuse.
+    """
+    import jax
+
+    from lfm_quant_tpu.parallel.mesh import mesh_fingerprint
+
+    m, o, d = cfg.model, cfg.optim, cfg.data
+    return (
+        "trainer",
+        jax.default_backend(),
+        mesh_fingerprint(mesh),
+        n_seq,
+        # Model: build_model inputs (resolved via config.model_kwargs,
+        # which is deterministic in these plus backend/n_seq).
+        (m.kind, freeze(m.kwargs), m.bf16, m.scan_impl,
+         cfg.is_heteroscedastic),
+        # Optimizer/schedule: all constants baked into the traced update,
+        # including the schedule horizon (steps_per_epoch × epochs).
+        (o.lr, o.weight_decay, o.warmup_steps, o.grad_clip, o.epochs,
+         o.loss, o.optimizer, steps_per_epoch),
+        # Data geometry reaching traces as constants.
+        (d.window, d.dates_per_batch),
+        (gather_impl, eval_gather_impl, eval_gather_sharded, fp),
+    )
+
+
+def ensemble_program_key(inner_key: Tuple, mesh, n_seeds: int,
+                         seed_block: int) -> Tuple:
+    """Cache key for the seed-vmapped ensemble wrappers: the inner
+    trainer's key (already mesh/backend-qualified) plus the seed-stack
+    geometry. A changed ``n_seeds`` or ``seed_block`` is a different
+    vmapped program — fresh compile, never stale reuse."""
+    from lfm_quant_tpu.parallel.mesh import mesh_fingerprint
+
+    return ("ensemble", inner_key, mesh_fingerprint(mesh), n_seeds,
+            seed_block)
+
+
+def get_programs(key: Tuple, builder: Callable[[], Any]) -> Any:
+    """Fetch the compiled-program bundle for ``key``, building (and
+    caching) on miss. With reuse disabled, always builds and never
+    caches — the serial-path A/B baseline."""
+    if reuse_enabled():
+        entry = _PROGRAM_CACHE.pop(key, None)
+        if entry is not None:
+            _PROGRAM_CACHE[key] = entry  # re-insert: LRU recency order
+            REUSE_COUNTERS.program_cache_hits += 1
+            return entry
+    REUSE_COUNTERS.program_cache_misses += 1
+    entry = builder()
+    if reuse_enabled():
+        _PROGRAM_CACHE[key] = entry
+        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+    return entry
+
+
+def clear_program_cache() -> None:
+    """Drop all cached program bundles (tests / explicit invalidation).
+    Outstanding trainers keep working — they hold their own references —
+    but the next construction rebuilds from scratch."""
+    _PROGRAM_CACHE.clear()
+
+
+def program_cache_size() -> int:
+    return len(_PROGRAM_CACHE)
+
+
+_PERSISTENT_CACHE_DIR: Optional[str] = None
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None
+                            ) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (the
+    ``RunConfig.compilation_cache_dir`` knob), falling back to the
+    ``LFM_COMPILATION_CACHE`` env var; JAX's own
+    ``JAX_COMPILATION_CACHE_DIR`` keeps working independently. Returns
+    the directory in effect (None = feature off). Idempotent; the
+    min-compile-time/entry-size floors are dropped to zero so even the
+    toy walk-forward programs persist (the default 1 s floor would skip
+    exactly the many-small-programs workload this repo runs). Unknown
+    options on older jax degrade silently — the cache is an
+    optimization, never a requirement.
+
+    Ordering constraint (measured on jax 0.4.37): the cache must be
+    configured before the process's FIRST XLA compile — once anything
+    jits without a cache dir, later ``config.update`` calls never attach
+    the cache in-process. Trainer construction calls this before its
+    first dispatch, so a cold ``train.py``/walk-forward process is in
+    time; a REPL that already ran jitted code is not (entries silently
+    stop being written — same degrade-don't-fail contract as above)."""
+    global _PERSISTENT_CACHE_DIR
+    cache_dir = cache_dir or os.environ.get("LFM_COMPILATION_CACHE")
+    if not cache_dir or _PERSISTENT_CACHE_DIR == cache_dir:
+        return _PERSISTENT_CACHE_DIR
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    for opt, val in (("jax_compilation_cache_dir", cache_dir),
+                     ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(opt, val)
+        except AttributeError:
+            if opt == "jax_compilation_cache_dir":
+                return None  # cache unsupported on this jax — feature off
+    _PERSISTENT_CACHE_DIR = cache_dir
+    return cache_dir

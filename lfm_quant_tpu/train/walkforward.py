@@ -12,10 +12,19 @@ per-fold forecasts yields one out-of-sample forecast panel where every
 prediction comes from a model that saw strictly earlier data — the input
 ``backtest.py`` grades.
 
-TPU notes: each fold is a full Trainer/EnsembleTrainer run over the SAME
-HBM-resident panel (PanelSplits never slices, so fold boundaries are
-free); the per-fold prediction window is a bounded month-index range
-passed straight to ``predict(date_range=...)``.
+TPU notes: each fold retrains over the SAME HBM-resident panel
+(PanelSplits never slices, so fold boundaries are free); the per-fold
+prediction window is a bounded month-index range passed straight to
+``predict(date_range=...)``. The sweep holds ONE trainer and
+``rebind()``s it per fold, so the cross-fold reuse layer
+(train/reuse.py) makes the whole sweep compile once and transfer the
+panel once: fold k+1 binds fold k's jitted executables and device-
+resident panel whenever the program key is unchanged (same-shape folds —
+any ``train_months`` rolling window, or an expanding window whose
+eligible-date count doesn't cross a ``dates_per_batch`` boundary). Every
+fold record carries the measured compile/transfer deltas (``reuse``
+key), so the amortization is asserted by tests and the
+``bench.py walkforward_reuse`` metric, not assumed.
 """
 
 from __future__ import annotations
@@ -78,11 +87,43 @@ def walkforward_folds(panel: Panel, start: int, step_months: int,
     return folds
 
 
+def _load_fold_best_params(trainer, fold_dir: str):
+    """Best params of a previously-completed fold, restored from its
+    ``ckpt/best`` line — the warm-start carry for folds whose in-memory
+    predecessor state is gone (``resume`` skipped the fold in this
+    process). Returns None (fresh init, with a warning) when the
+    checkpoint line is missing or unreadable: a degraded carry must not
+    kill a multi-fold resume."""
+    import warnings
+
+    from lfm_quant_tpu.train.checkpoint import CheckpointManager
+    from lfm_quant_tpu.train.loop import restore_state_dict
+
+    mgr = CheckpointManager(os.path.join(fold_dir, "ckpt", "best"),
+                            max_to_keep=1)
+    try:
+        if mgr.latest_step() is None:
+            warnings.warn(
+                f"warm_start: no best checkpoint under {fold_dir} — "
+                "fold falls back to a fresh init")
+            return None
+        restored = restore_state_dict(mgr, trainer.init_state()._asdict())
+        return restored["params"]
+    except Exception as e:  # noqa: BLE001 — degrade, don't kill the sweep
+        warnings.warn(
+            f"warm_start: could not restore {fold_dir} best checkpoint "
+            f"({type(e).__name__}: {e}) — fold falls back to a fresh init")
+        return None
+    finally:
+        mgr.close()
+
+
 def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                     step_months: int = 12, val_months: int = 24,
                     n_folds: Optional[int] = None, out_dir: Optional[str] = None,
                     echo: bool = False, resume: bool = False,
-                    warm_start: bool = False
+                    warm_start: bool = False,
+                    train_months: Optional[int] = None
                     ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
     """Train a model (or seed ensemble, ``cfg.n_seeds > 1``) per fold and
     stitch the out-of-sample forecasts.
@@ -120,13 +161,26 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     prediction window, so the out-of-sample property is intact — the carry
     only moves the fold's starting point closer to a solution, the
     wall-clock lever for multi-decade retraining sweeps. Off by default
-    (fresh folds are independent draws, the reference protocol). A fold
-    skipped by ``resume`` breaks the carry chain — the next trained fold
-    falls back to a fresh init (its predecessor's in-memory params are
-    gone; correctness is unaffected).
+    (fresh folds are independent draws, the reference protocol). Folds
+    skipped by ``resume`` no longer break the carry chain: when the
+    predecessor's in-memory params are gone, the first trained fold
+    restores them from the predecessor fold dir's ``ckpt/best``
+    (falling back to a fresh init, with a warning, only when that
+    checkpoint line is missing).
+
+    ``train_months``: rolling train window length in months (None =
+    expanding window, the reference protocol — every fold trains on all
+    history). A rolling window keeps every fold's batch shapes identical,
+    which is what lets the cross-fold reuse layer run the whole sweep on
+    ONE set of compiled programs: each fold record's ``reuse`` dict
+    carries the measured per-fold compile/transfer deltas
+    (``jit_traces``, ``panel_transfers``, cache hit/miss counts — see
+    utils/profiling.py ReuseCounters), and on a same-shape schedule every
+    fold after the first reports zero for both.
     """
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
     from lfm_quant_tpu.train.loop import Trainer
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
 
     folds = walkforward_folds(panel, start, step_months, val_months, n_folds)
     ensemble = cfg.n_seeds > 1
@@ -176,10 +230,18 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                                  f"{forecast.shape} — n_seeds changed?")
 
     prev_params = None
+    trainer = None
     for k, (train_end, val_end, pred_range) in enumerate(folds):
         if k < len(records):
             continue  # fold already completed in a previous run
-        splits = PanelSplits.by_date(panel, train_end, val_end)
+        # Per-fold compile/transfer accounting: the deltas land in the
+        # fold record, making the reuse layer's zero-recompile claim a
+        # measured per-fold property.
+        reuse_snap = REUSE_COUNTERS.snapshot()
+        train_start = (month_add(train_end, -train_months)
+                       if train_months else None)
+        splits = PanelSplits.by_date(panel, train_end, val_end,
+                                     train_start=train_start)
         run_dir = os.path.join(out_dir, f"fold_{k}") if out_dir else None
         # Per-fold seed offset keeps fold models independent draws while
         # staying replayable.
@@ -197,14 +259,32 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
             os.makedirs(run_dir, exist_ok=True)
             save_cfg = dataclasses.replace(
                 fold_cfg, data=dataclasses.replace(
-                    fold_cfg.data, train_end=train_end, val_end=val_end))
+                    fold_cfg.data, train_end=train_end, val_end=val_end,
+                    train_start=train_start))
             with open(os.path.join(run_dir, "config.json"), "w") as fh:
                 fh.write(save_cfg.to_json())
             # Also CLEARS a stale flag when a reused dir flips trainer
             # kind between runs.
             mark_ensemble_run_dir(run_dir, ensemble)
-        trainer = (EnsembleTrainer if ensemble else Trainer)(
-            fold_cfg, splits, run_dir=run_dir, echo=echo)
+        # ONE trainer for the whole sweep, rebound per fold: rebind()
+        # resets TrainState, sampler seeds and split boundaries without
+        # rebuilding the jit wrappers (an unchanged program key keeps the
+        # exact executables; a changed one rebuilds through the cache —
+        # never stale reuse). Constructing fresh trainers would reuse
+        # programs too (the caches are module-level), but rebind keeps
+        # the sweep's intent explicit and skips re-running construction-
+        # time validation per fold.
+        if trainer is None:
+            trainer = (EnsembleTrainer if ensemble else Trainer)(
+                fold_cfg, splits, run_dir=run_dir, echo=echo)
+        else:
+            trainer.rebind(fold_cfg, splits, run_dir=run_dir)
+        if warm_start and prev_params is None and k > 0 and out_dir:
+            # The in-memory carry is gone (folds skipped by resume in
+            # this process) — restore the predecessor fold's best params
+            # from its run dir so the chain survives crash recovery.
+            prev_params = _load_fold_best_params(
+                trainer, os.path.join(out_dir, f"fold_{k - 1}"))
         used_warm = warm_start and prev_params is not None
         fit = trainer.fit(resume=resume and run_dir is not None,
                           init_params=prev_params if used_warm else None)
@@ -231,6 +311,11 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
             "best_val_ic": fit["best_val_ic"],
             "epochs_run": fit["epochs_run"],
             "warm_started": used_warm,
+            # Fold-level compile/transfer cost: 0 jit_traces and 0
+            # panel_transfers on every fold after the first is the reuse
+            # layer's contract on a same-shape schedule (tests/test_reuse
+            # and bench.py walkforward_reuse assert it here).
+            "reuse": REUSE_COUNTERS.delta(reuse_snap),
         })
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)
@@ -243,6 +328,7 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
         "n_folds": len(folds),
         "step_months": step_months,
         "val_months": val_months,
+        "train_months": train_months,
         "n_seeds": cfg.n_seeds,
         "warm_start": warm_start,
         "oos_months": [int(panel.dates[folds[0][2][0]]),

@@ -15,8 +15,10 @@ firm-months.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
+import functools
 import time
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 import jax
 
@@ -72,3 +74,69 @@ class StepTimer:
     def throughput(self) -> float:
         """firm-months/sec over all recorded steps (0 if nothing timed)."""
         return self.firm_months / self.seconds if self.seconds > 0 else 0.0
+
+
+@dataclasses.dataclass
+class ReuseCounters:
+    """Process-wide compile/transfer accounting for the cross-fold reuse
+    layer (train/reuse.py). The point of the walk-forward reuse work is
+    that fold k+1 pays ZERO re-tracing and ZERO panel H2D re-transfer —
+    these counters make that a measured, assertable property (fold
+    records in train/walkforward.py, the ``walkforward_reuse`` bench
+    metric, and the ``reuse``-marked regression tests) instead of a
+    claim.
+
+    * ``jit_traces`` — number of times a reuse-layer jitted program was
+      (re)traced. Python trace == XLA (re)compile for these programs:
+      each wrapper body (see :func:`count_traces`) only executes when
+      jax.jit misses its executable cache for a new input signature.
+    * ``panel_transfers`` / ``panel_bytes`` — device_panel H2D transfer
+      events and their approximate wire bytes (data/windows.py).
+    * ``program_cache_hits`` / ``_misses`` — compiled-program cache
+      outcomes (train/reuse.py); a miss means a trainer had to BUILD
+      fresh jit wrappers (which then trace lazily on first dispatch).
+    * ``panel_cache_hits`` — device-panel residency cache hits (a fold
+      bound an already-resident panel instead of re-transferring).
+    """
+
+    jit_traces: int = 0
+    panel_transfers: int = 0
+    panel_bytes: int = 0
+    program_cache_hits: int = 0
+    program_cache_misses: int = 0
+    panel_cache_hits: int = 0
+
+    def snapshot(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+    def delta(self, since: Dict[str, int]) -> Dict[str, int]:
+        """Counter increments since a :meth:`snapshot`."""
+        now = self.snapshot()
+        return {k: now[k] - since.get(k, 0) for k in now}
+
+    def reset(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, 0)
+
+
+#: The process-wide instance every hook point bumps. Deltas (snapshot /
+#: delta pairs) are the supported read pattern — absolute values mix all
+#: trainers ever built in the process.
+REUSE_COUNTERS = ReuseCounters()
+
+
+def count_traces(name: str, fn: Callable) -> Callable:
+    """Wrap the OUTERMOST callable handed to ``jax.jit`` so every trace
+    bumps ``REUSE_COUNTERS.jit_traces``. The wrapper body runs exactly
+    when jit traces (a cached executable skips Python entirely), so the
+    counter equals the number of XLA compilations these programs cost.
+    ``functools.wraps`` keeps the signature visible for static_argnames
+    resolution. ``name`` is for debuggability in tracebacks only."""
+
+    @functools.wraps(fn)
+    def traced(*args, **kwargs):
+        REUSE_COUNTERS.jit_traces += 1
+        return fn(*args, **kwargs)
+
+    traced.__qualname__ = f"count_traces[{name}]"
+    return traced

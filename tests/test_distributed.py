@@ -11,9 +11,17 @@ import subprocess
 import sys
 import textwrap
 
+import jax
 import pytest
 
 from lfm_quant_tpu.utils.distributed import maybe_initialize
+
+# jax 0.4.x's CPU client has no cross-process collectives at all
+# ("Multiprocess computations aren't implemented on the CPU backend") —
+# the two-process smoke tests need a jax whose CPU backend can.
+_CPU_MULTIPROCESS = pytest.mark.skipif(
+    jax.__version__.startswith("0.4."),
+    reason="CPU backend lacks multiprocess collectives on jax 0.4.x")
 
 
 def test_empty_env_is_noop():
@@ -36,7 +44,11 @@ _WORKER = textwrap.dedent("""
     import os, sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)  # 2 local → 4 global
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)  # 2 local → 4 global
+    except AttributeError:  # jax 0.4.x — legacy spelling (see conftest.py)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
     from lfm_quant_tpu.utils.distributed import maybe_initialize
     assert maybe_initialize() is True
     import jax.numpy as jnp
@@ -49,15 +61,17 @@ _WORKER = textwrap.dedent("""
     mesh = Mesh(jax.devices(), ("d",))
     ones = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P("d")), jnp.ones((2,), jnp.float32), (4,))
+    from lfm_quant_tpu.parallel.mesh import shard_map_compat
     total = jax.jit(
-        jax.shard_map(lambda x: jax.lax.psum(x, "d"),
-                      mesh=mesh, in_specs=P("d"), out_specs=P()),
+        shard_map_compat(lambda x: jax.lax.psum(x, "d"),
+                         mesh=mesh, in_specs=P("d"), out_specs=P()),
     )(ones)
     assert float(total[0]) == 4.0, total
     print(f"proc {os.environ['LFM_PROCESS_ID']} OK", flush=True)
 """)
 
 
+@_CPU_MULTIPROCESS
 def test_two_process_smoke(tmp_path):
     """Two real processes, localhost coordinator, CPU backend. Skipped
     where localhost sockets are unavailable (sandboxed CI)."""
@@ -137,9 +151,14 @@ _MH_SETUP = textwrap.dedent("""
 """)
 
 _TRAIN_WORKER = textwrap.dedent("""
+    import os
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)  # 2 local -> 4 global
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)  # 2 local -> 4 global
+    except AttributeError:  # jax 0.4.x — legacy spelling (see conftest.py)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
     from lfm_quant_tpu.utils.distributed import maybe_initialize
     assert maybe_initialize() is True
     assert jax.process_count() == 2 and jax.device_count() == 4
@@ -153,6 +172,7 @@ _TRAIN_WORKER = textwrap.dedent("""
 """)
 
 
+@_CPU_MULTIPROCESS
 def test_two_process_trainer_matches_single_process(tmp_path, monkeypatch):
     """The REAL multi-host surface: a Trainer with a 4-way date-sharded
     mesh spanning two processes must produce (nearly) the same losses as

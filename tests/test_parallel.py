@@ -197,9 +197,13 @@ def test_shard_map_pallas_matches_single_device_xla(lstm_panel, tmp_path):
         s_x, m_x = t_xla._jit_step(s_x, t_xla.dev, *t_xla._batch_args(b, train=True))
         s_p, m_p = t_pal._jit_step(s_p, t_pal.dev, *t_pal._batch_args(b, train=True))
     assert float(m_x["loss"]) == pytest.approx(float(m_p["loss"]), rel=1e-3)
+    # atol covers two epochs of accumulated interpret-mode-vs-XLA float
+    # drift; jax 0.4.x's shard_map (check_rep) reorders reductions
+    # slightly differently than newer releases, so the bound is 5e-5
+    # rather than 1e-5 on params of scale ~1e-2.
     for a, b in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_p.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-3, atol=1e-5)
+                                   rtol=1e-3, atol=5e-5)
     # The eval forward (GSPMD path, XLA twin model reading the lane-padded
     # panel through fp) agrees across the two trainers.
     v_x = t_xla.evaluate(s_x.params)
@@ -221,9 +225,10 @@ def test_shard_map_multi_step_pallas(lstm_panel, tmp_path):
         s_p, t_pal.dev, *t_pal._batch_args(b, train=True, steps=True))
     np.testing.assert_allclose(np.asarray(m_x["loss"]),
                                np.asarray(m_p["loss"]), rtol=1e-3, atol=1e-5)
+    # Same accumulated-drift bound as test_shard_map_pallas_matches_....
     for a, c in zip(jax.tree.leaves(s_x.params), jax.tree.leaves(s_p.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
-                                   rtol=1e-3, atol=1e-5)
+                                   rtol=1e-3, atol=5e-5)
 
 
 def test_sharded_eval_pallas_gather_promotion(lstm_panel, tmp_path,

@@ -19,9 +19,14 @@ import pytest
 pytestmark = [pytest.mark.slow, pytest.mark.nightly]
 
 _POD = textwrap.dedent("""
+    import os
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 64)
+    try:
+        jax.config.update("jax_num_cpu_devices", 64)
+    except AttributeError:  # jax 0.4.x — legacy spelling (see conftest.py)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=64").strip()
     import numpy as np
     from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
                                       RunConfig)

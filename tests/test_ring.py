@@ -47,7 +47,9 @@ def full_attention(q, k, v, m):
 
 
 def _ring(q, k, v, m, mesh):
-    fn = jax.shard_map(
+    from lfm_quant_tpu.parallel.mesh import shard_map_compat
+
+    fn = shard_map_compat(
         functools.partial(ring_attention, axis_name="seq"),
         mesh=mesh,
         in_specs=(P(None, None, "seq", None),) * 3 + (P(None, "seq"),),
@@ -136,8 +138,8 @@ def test_sequence_parallel_transformer_grads():
 
     g_p = jax.grad(loss_plain)(params)
     g_s = jax.grad(loss_seq)(params)
-    flat_p = jax.tree.leaves_with_path(g_p)
-    flat_s = dict(jax.tree.leaves_with_path(g_s))
+    flat_p = jax.tree_util.tree_leaves_with_path(g_p)
+    flat_s = dict(jax.tree_util.tree_leaves_with_path(g_s))
     assert len(flat_p) == len(flat_s)
     for path, leaf in flat_p:
         np.testing.assert_allclose(
@@ -190,8 +192,8 @@ def test_sequence_parallel_lru_grads():
     # trips an XLA sharding-override assert on associative_scan's
     # transpose in this JAX version; the training path is always jitted
     # (train/loop.py), so jit-compiled AD is the semantics to pin.
-    g_p = jax.tree.leaves_with_path(jax.jit(jax.grad(loss_plain))(params))
-    g_s = dict(jax.tree.leaves_with_path(jax.jit(jax.grad(loss_seq))(params)))
+    g_p = jax.tree_util.tree_leaves_with_path(jax.jit(jax.grad(loss_plain))(params))
+    g_s = dict(jax.tree_util.tree_leaves_with_path(jax.jit(jax.grad(loss_seq))(params)))
     assert len(g_p) == len(g_s)
     for path, leaf in g_p:
         np.testing.assert_allclose(

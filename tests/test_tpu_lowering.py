@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 
 from lfm_quant_tpu.ops.pallas_gather import gather_windows_pallas
 from lfm_quant_tpu.ops.pallas_rnn import rnn_scan, rnn_scan_fused
+from lfm_quant_tpu.parallel.mesh import shard_map_compat
 
 CELLS = ["lstm", "gru"]
 GATES = {"lstm": 4, "gru": 3}
@@ -109,18 +110,18 @@ def test_shard_map_per_shard_geometry_lowers(impl):
             return (rnn_scan("lstm", xw, wh, m,
                              interpret=False) ** 2).sum()
 
-        f = jax.shard_map(jax.grad(loss, argnums=(0, 1)), mesh=mesh,
-                          in_specs=(P("data"), P(), P("data")),
-                          out_specs=(P("data"), P()), check_vma=False)
+        f = shard_map_compat(jax.grad(loss, argnums=(0, 1)), mesh=mesh,
+                             in_specs=(P("data"), P(), P("data")),
+                             out_specs=(P("data"), P()), check_vma=False)
         args = (_sds((B, T, G)), _sds((H, G)), _sds((B, T)))
     else:
         def loss(hin, wx, b, wh, m):
             return (rnn_scan_fused("lstm", hin, wx, b, wh, m,
                                    interpret=False) ** 2).sum()
 
-        f = jax.shard_map(jax.grad(loss, argnums=(1, 2, 3)), mesh=mesh,
-                          in_specs=(P("data"), P(), P(), P(), P("data")),
-                          out_specs=(P(), P(), P()), check_vma=False)
+        f = shard_map_compat(jax.grad(loss, argnums=(1, 2, 3)), mesh=mesh,
+                             in_specs=(P("data"), P(), P(), P(), P("data")),
+                             out_specs=(P(), P(), P()), check_vma=False)
         args = (_sds((B, T, H)), _sds((H, G)), _sds((G,)), _sds((H, G)),
                 _sds((B, T)))
     _lower_tpu(f, *args)

@@ -58,12 +58,18 @@ def main(argv=None) -> int:
                          "the wall-clock lever for long retraining sweeps; "
                          "no lookahead (the prior fold saw strictly "
                          "earlier data)")
+    ap.add_argument("--wf-train-months", type=int, default=None,
+                    help="rolling train window per fold (months; default: "
+                         "expanding window). Fixed-length folds keep "
+                         "identical batch shapes, so the cross-fold reuse "
+                         "layer compiles the whole sweep exactly once")
     args = ap.parse_args(argv)
     if args.walk_forward is None and (
             args.wf_start is not None or args.wf_folds is not None
-            or args.wf_val_months != 24 or args.wf_warm_start):
-        ap.error("--wf-start/--wf-val-months/--wf-folds/--wf-warm-start "
-                 "need --walk-forward STEP_MONTHS")
+            or args.wf_val_months != 24 or args.wf_warm_start
+            or args.wf_train_months is not None):
+        ap.error("--wf-start/--wf-val-months/--wf-folds/--wf-warm-start/"
+                 "--wf-train-months need --walk-forward STEP_MONTHS")
 
     # Import late so --help works instantly without initializing JAX.
     import dataclasses
@@ -121,7 +127,8 @@ def main(argv=None) -> int:
                 cfg, panel, start=start, step_months=args.walk_forward,
                 val_months=args.wf_val_months, n_folds=args.wf_folds,
                 out_dir=wf_dir, echo=args.echo, resume=args.resume,
-                warm_start=args.wf_warm_start)
+                warm_start=args.wf_warm_start,
+                train_months=args.wf_train_months)
             summary["run_dir"] = wf_dir
         elif cfg.n_seeds > 1:
             from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
