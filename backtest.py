@@ -57,7 +57,13 @@ def main(argv=None) -> int:
                     help="also print the calendar-year breakdown")
     args = ap.parse_args(argv)
 
-    from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
+    from lfm_quant_tpu.backtest import aggregate_ensemble, resolve_backtest
+
+    # Engine dispatch: the fused device-resident backtest
+    # (backtest/jax_engine.py — all months in one jitted dispatch) by
+    # default, the numpy reference under LFM_JAX_BACKTEST=0 or when jax
+    # is unavailable. Same report either way (parity-suite contract).
+    run_backtest = resolve_backtest()
 
     if args.forecast_npz:
         import numpy as np

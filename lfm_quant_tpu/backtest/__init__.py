@@ -1,9 +1,51 @@
-"""Backtest / evaluation layer: forecasts → portfolio → performance report."""
+"""Backtest / evaluation layer: forecasts → portfolio → performance report.
+
+Two engines, one contract:
+
+* ``engine`` — the numpy reference (host loop). Golden for parity.
+* ``jax_engine`` — the fused device-resident path (all months in one
+  jitted dispatch; multi-mode aggregation from one stacked tensor).
+
+``resolve_backtest()`` picks the engine for the CLIs/walk-forward:
+the fused path is the default, ``LFM_JAX_BACKTEST=0`` (or a jax import
+failure) falls back to the numpy reference.
+"""
+
+import os
 
 from lfm_quant_tpu.backtest.engine import (
     BacktestReport,
     aggregate_ensemble,
+    assemble_report,
     run_backtest,
 )
 
-__all__ = ["BacktestReport", "run_backtest", "aggregate_ensemble"]
+
+def jax_backtest_enabled() -> bool:
+    """The fused-scoring knob: ``LFM_JAX_BACKTEST`` (default ON)."""
+    return os.environ.get("LFM_JAX_BACKTEST", "1") != "0"
+
+
+def resolve_backtest():
+    """The backtest callable the serving paths should dispatch through:
+    ``jax_engine.run_backtest_jax`` when the knob is on and jax imports,
+    else the numpy ``run_backtest`` reference (same signature, same
+    report — the fused path is an optimization, never a requirement)."""
+    if jax_backtest_enabled():
+        try:
+            from lfm_quant_tpu.backtest.jax_engine import run_backtest_jax
+
+            return run_backtest_jax
+        except ImportError:
+            pass
+    return run_backtest
+
+
+__all__ = [
+    "BacktestReport",
+    "run_backtest",
+    "aggregate_ensemble",
+    "assemble_report",
+    "jax_backtest_enabled",
+    "resolve_backtest",
+]

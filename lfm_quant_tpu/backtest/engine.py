@@ -53,18 +53,35 @@ class BacktestReport:
 
     def yearly(self) -> dict:
         """Calendar-year breakdown: {year: {"ret", "bench", "mean_ic",
-        "n_months"}} with returns compounded within the year."""
+        "n_months"}} with returns compounded within the year.
+
+        Vectorized with ``np.ufunc.reduceat`` over year-boundary indices
+        (dates are sorted formation months, so each year is one contiguous
+        segment) — ``multiply.reduceat`` applies the SAME left-to-right
+        reduction order as the old per-year ``np.prod`` loop, so the
+        numbers are bit-identical while a 50-year report stops paying one
+        Python iteration (plus boolean scans over the full series) per
+        year."""
         years = np.asarray(self.dates) // 100
-        out = {}
-        for y in np.unique(years):
-            ix = years == y
-            out[int(y)] = {
-                "ret": float(np.prod(1.0 + self.monthly_returns[ix]) - 1.0),
-                "bench": float(np.prod(1.0 + self.monthly_bench[ix]) - 1.0),
-                "mean_ic": float(self.monthly_ic[ix].mean()),
-                "n_months": int(ix.sum()),
+        starts = np.flatnonzero(np.r_[True, years[1:] != years[:-1]])
+        counts = np.diff(np.r_[starts, years.size])
+        # Same dtype promotion as the old per-year np.prod loop (multiply
+        # .reduce is sequential, so each segment reduces in the identical
+        # order) — ret/bench stay bit-compatible with prior reports;
+        # mean_ic deliberately accumulates in float64 (≈1e-9 more
+        # accurate than the old float32 .mean()).
+        ret = np.multiply.reduceat(1.0 + np.asarray(self.monthly_returns), starts) - 1.0
+        bench = np.multiply.reduceat(1.0 + np.asarray(self.monthly_bench), starts) - 1.0
+        ic = np.add.reduceat(np.asarray(self.monthly_ic, np.float64), starts) / counts
+        return {
+            int(years[s]): {
+                "ret": float(ret[i]),
+                "bench": float(bench[i]),
+                "mean_ic": float(ic[i]),
+                "n_months": int(counts[i]),
             }
-        return out
+            for i, s in enumerate(starts)
+        }
 
     def to_json(self) -> str:
         d = dataclasses.asdict(self)
@@ -85,9 +102,40 @@ class BacktestReport:
         )
 
 
+#: Known aggregation modes (shared vocabulary of the numpy reference,
+#: the device-resident jax_engine, and the CLIs).
+ENSEMBLE_MODES = ("mean", "mean_minus_std", "mean_minus_total_std")
+
+
+def normalize_modes(modes, risk_lambda: float = 1.0):
+    """Mode specs → [(mode, λ)]: each entry is a mode name (taking the
+    default ``risk_lambda``) or an explicit ``(mode, λ)`` pair — the λ
+    grid of the uncertainty_aggregation sweep. Lives on the numpy side
+    so mode vocabulary needs no jax import."""
+    specs = []
+    for m in modes:
+        mode, lam = m if isinstance(m, tuple) else (m, risk_lambda)
+        if mode not in ENSEMBLE_MODES:
+            raise ValueError(f"unknown ensemble mode {mode!r}")
+        specs.append((mode, float(lam)))
+    return specs
+
+
+def mode_label(mode: str, lam: float) -> str:
+    """Stable dict key for a (mode, λ) spec; the plain mode name when λ
+    is irrelevant (mean), matching the single-mode CLI vocabulary."""
+    return mode if mode == "mean" else f"{mode}@{lam:g}"
+
+
 def _spearman(a: np.ndarray, b: np.ndarray) -> float:
-    ra = np.argsort(np.argsort(a)).astype(np.float64)
-    rb = np.argsort(np.argsort(b)).astype(np.float64)
+    # kind="stable": ties rank in index order — a DEFINED tie-break the
+    # fused JAX engine (stable argsort by construction) reproduces
+    # exactly; the default introsort's tie order is implementation-
+    # arbitrary, which would make engine parity untestable on ties.
+    ra = np.argsort(np.argsort(a, kind="stable"),
+                    kind="stable").astype(np.float64)
+    rb = np.argsort(np.argsort(b, kind="stable"),
+                    kind="stable").astype(np.float64)
     ra -= ra.mean()
     rb -= rb.mean()
     denom = np.sqrt((ra * ra).sum() * (rb * rb).sum())
@@ -182,7 +230,10 @@ def run_backtest(
             continue
         f = forecast[uni, t]
         k = max(1, int(round(uni.size * quantile)))
-        order = np.argsort(f)
+        # Stable sort: tied forecasts keep firm-index order, so the
+        # portfolio boundary is well-defined and the fused JAX engine
+        # (backtest/jax_engine.py) forms bit-identical portfolios.
+        order = np.argsort(f, kind="stable")
         long_ix = uni[order[-k:]]
         port_ret = float(panel.returns[long_ix, t].mean())
         if long_short:
@@ -212,12 +263,36 @@ def run_backtest(
         ret_ics.append(_spearman(f, panel.returns[uni, t]))
         dates.append(int(panel.dates[t]))
 
-    if not rets:
+    return assemble_report(
+        rets, ics, ret_ics, benches, turns, dates, skipped,
+        profile_sum, profile_cnt, min_universe=min_universe,
+        periods_per_year=periods_per_year, rf_monthly=rf_monthly,
+    )
+
+
+def assemble_report(rets, ics, ret_ics, benches, turns, dates, skipped,
+                    profile_sum, profile_cnt, *, min_universe: int,
+                    periods_per_year: int = 12, rf_monthly: float = 0.0,
+                    ) -> BacktestReport:
+    """Per-month series → :class:`BacktestReport` summary statistics.
+
+    The ONE place the portfolio statistics (CAGR/Sharpe/IR/t-stat/max-DD)
+    are computed: both the numpy reference engine and the fused JAX
+    engine (backtest/jax_engine.py) hand their per-used-month series to
+    this function, so the two paths can only diverge in the per-month
+    numbers — which the parity suite pins — never in the report math.
+    All inputs are sequences over USED months (thin months already
+    dropped); ``turns`` has one fewer entry (no predecessor portfolio in
+    the first used month).
+    """
+    rets = np.asarray(rets, np.float64)
+    if rets.size == 0:
         raise ValueError(
             f"no month had a universe of >= {min_universe} forecastable firms"
         )
-    r = np.asarray(rets, np.float64)
+    r = rets
     b = np.asarray(benches, np.float64)
+    turns = np.asarray(turns, np.float64)
     excess = r - rf_monthly
     growth = np.cumprod(1.0 + r)
     years = len(r) / periods_per_year
@@ -241,10 +316,10 @@ def run_backtest(
         mean_ic=float(np.mean(ics)),
         mean_ret_ic=float(np.mean(ret_ics)),
         max_drawdown=max_dd,
-        turnover=float(np.mean(turns)) if turns else 0.0,
+        turnover=float(turns.mean()) if turns.size else 0.0,
         hit_rate=float((r > 0).mean()),
         n_months=len(r),
-        n_skipped_months=skipped,
+        n_skipped_months=int(skipped),
         bench_cagr=bench_cagr,
         excess_cagr=cagr - bench_cagr,
         ir_ann=ir,
@@ -253,6 +328,6 @@ def run_backtest(
         monthly_ic=np.asarray(ics, np.float32),
         monthly_bench=b.astype(np.float32),
         dates=np.asarray(dates, np.int32),
-        quantile_profile=(profile_sum
+        quantile_profile=(np.asarray(profile_sum, np.float64)
                           / np.maximum(profile_cnt, 1)).astype(np.float32),
     )

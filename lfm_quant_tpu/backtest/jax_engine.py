@@ -1,0 +1,438 @@
+"""Device-resident scoring: the fused predict → aggregate → backtest path.
+
+The numpy engine (backtest/engine.py) iterates ``for t in range(T)`` with
+a nested per-bucket loop and a double-argsort Spearman per month — a
+serial host loop that dominates end-to-end latency for the serving
+workload (walk-forward re-scoring and the ``uncertainty_aggregation``
+sweep: 171 OOS months × seeds × aggregation modes) once training is
+warm. The same lesson the training path learned from the related work
+applies on the TIME axis: what looks sequential is batchable
+(PAPERS.md — "Large-Batch Training for LSTM and Beyond" for throughput
+scaling, "Parallelizing Linear Recurrent Neural Nets Over Sequence
+Length" for parallelism over the sequence dimension). Months are
+independent given the forecast panel, so the whole monthly loop is one
+``vmap``; only the turnover chain is truly sequential, and that is a
+[T]-step ``lax.scan`` over an [N]-bool carry, not a Python loop.
+
+Shape of the fused path (a handful of dispatches, not O(T·K·modes)
+Python iterations):
+
+* ``run_backtest_jax`` — drop-in twin of ``engine.run_backtest``: ONE
+  jitted dispatch computes every month's portfolio formation (stable
+  masked argsort ranks + exact ``k``-of-``n`` selection via a
+  precomputed k-table), monthly rank-IC (``ops/metrics.spearman_ic`` —
+  the same tie-handling as the reference's double argsort), the
+  equal-weight benchmark, the decile profile (``segment_sum`` over
+  forecast-rank buckets) and the turnover/cost chain. Host work is one
+  small D2H of [T]-shaped series plus the shared
+  ``engine.assemble_report`` summary math — the numpy engine stays the
+  golden reference the parity suite compares against.
+* ``aggregate_scores_device`` — evaluates ALL aggregation modes
+  (mean, mean−λ·std, mean−λ·total_std, any λ grid) from one stacked
+  [S, N, T] forecast tensor in one dispatch, without re-materializing
+  the stack per mode.
+* ``run_scoring_pipeline`` — aggregate + backtest for a whole mode
+  sweep in ONE core dispatch (modes ride a leading vmap axis of the
+  same compiled program).
+
+Parity discipline (pinned by tests/test_jax_backtest.py):
+
+* Selection count: numpy uses ``max(1, int(round(n * quantile)))`` in
+  float64. Recomputing ``n · quantile`` in on-device float32 could round
+  the other way across the .5 boundary, so ``k`` comes from a
+  host-precomputed ``k_table[n]`` with the exact numpy semantics.
+* Ordering: ``jnp.argsort`` is stable, and invalid slots are pushed to
+  ``+inf``, so valid entries keep exactly the relative order numpy's
+  stable subset argsort produces — ties land in the same buckets and
+  portfolios on both engines.
+* Everything aggregate-shaped (profile sums, report statistics) is
+  accumulated on host in float64 via the shared ``assemble_report``.
+
+The engine selection knob is ``LFM_JAX_BACKTEST`` (default ON; ``0``
+falls back to the numpy engine) — see ``resolve_backtest`` in
+``backtest/__init__.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Dict, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lfm_quant_tpu.backtest.engine import (
+    BacktestReport,
+    assemble_report,
+    mode_label,
+    normalize_modes,
+)
+from lfm_quant_tpu.data.panel import Panel
+from lfm_quant_tpu.ops.metrics import hard_ranks, pearson_ic
+
+# Mode name → which uncertainty tensor the λ-penalty scales (static
+# program structure; λ itself is a traced argument, so a λ grid reuses
+# one compiled program).
+_MODE_KINDS = {"mean": 0, "mean_minus_std": 1, "mean_minus_total_std": 2}
+
+ModeSpec = Union[str, Tuple[str, float]]
+
+
+# ---- device residency ---------------------------------------------------
+#
+# The backtest-side panel arrays (forward returns, targets, validity,
+# tradeability) are not part of the training device panel
+# (data/windows.py keeps returns host-side on purpose — training never
+# reads them). The scoring pipeline is called many times per panel
+# (every fold × every mode sweep), so they get their own residency
+# cache: one H2D per panel object, month-major ([T, N]) because the
+# fused core vmaps over months. Same identity-keyed + weakref-evicted
+# contract as the training panel cache.
+
+_SCORE_PANEL_CACHE: dict = {}
+
+
+def _device_score_panel(panel: Panel) -> dict:
+    key = id(panel)
+    hit = _SCORE_PANEL_CACHE.get(key)
+    if hit is not None:
+        return hit
+    dev = {
+        "returns": jnp.asarray(np.ascontiguousarray(panel.returns.T)),
+        "targets": jnp.asarray(np.ascontiguousarray(panel.targets.T)),
+        "target_valid": jnp.asarray(
+            np.ascontiguousarray(panel.target_valid.T)),
+        "tradeable": jnp.asarray(np.ascontiguousarray(panel.tradeable().T)),
+    }
+    _SCORE_PANEL_CACHE[key] = dev
+    weakref.finalize(panel, _SCORE_PANEL_CACHE.pop, key, None)
+    return dev
+
+
+def clear_score_panel_cache() -> None:
+    """Drop all device-resident scoring panels (tests / memory pressure)."""
+    _SCORE_PANEL_CACHE.clear()
+
+
+def invalidate_score_panel(panel: Panel) -> int:
+    """Drop this panel's device-resident scoring arrays. Called by
+    ``data/windows.invalidate_panel`` so ONE invalidation hook covers
+    both residency caches — a panel mutated in place must never be
+    scored against stale device returns/targets. Returns entries
+    dropped."""
+    if id(panel) in _SCORE_PANEL_CACHE:
+        del _SCORE_PANEL_CACHE[id(panel)]
+        return 1
+    return 0
+
+
+@functools.lru_cache(maxsize=32)
+def _k_table(n_firms: int, quantile: float) -> jnp.ndarray:
+    """Exact numpy portfolio sizes for every possible universe count:
+    ``k_table[n] = max(1, int(round(n * quantile)))`` computed in host
+    float64 (round-half-even, like the reference engine) — on-device
+    float32 could land on the other side of a .5 boundary. Cached so the
+    hot scoring path (per fold × per mode sweep) pays the build + H2D
+    once per (universe size, quantile), like the panel residency cache."""
+    n = np.arange(n_firms + 1, dtype=np.float64)
+    return jnp.asarray(np.maximum(1, np.round(n * quantile)).astype(np.int32))
+
+
+# ---- the fused core -----------------------------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def _core_for(n_buckets: int):
+    """Build (and cache) the jitted all-months backtest core for a
+    profile-bucket count. One program serves every call with the same
+    bucket count and array shapes — quantile (k-table), min_universe,
+    costs and long/short arrive as traced arguments, so a mode/λ/cost
+    sweep pays ZERO recompiles after the first dispatch."""
+    from lfm_quant_tpu.utils.profiling import count_traces
+
+    def month_stats(f, u, r, rank_tgt, rank_r, tv_any, n, k):
+        """One month's cross-section × one mode's scores → portfolio/IC/
+        profile stats; mirrors one iteration of the numpy engine's month
+        loop. ``rank_tgt``/``rank_r`` are the month's PRECOMPUTED target/
+        return ranks — they don't depend on the scores, so the mode sweep
+        shares them and each (mode, month) pays exactly ONE sort: the
+        portfolio argsort below, whose scatter-of-iota is simultaneously
+        the forecast rank vector (ops/metrics.hard_ranks is the same
+        construction — sorts are the whole cost of this core on CPU)."""
+        n_slots = f.shape[0]
+        # Stable ascending sort with invalid slots pushed past every real
+        # score: slots 0..n-1 are the universe in forecast order, exactly
+        # numpy's stable argsort over the subset (ties keep index order).
+        # This sort + one inverse-permutation scatter are the ONLY
+        # per-(mode, month) O(N log N) ops: portfolio membership, IC
+        # ranks and profile buckets all derive elementwise from rank_f
+        # (XLA CPU scatters/gathers/segment-reduces cost more than the
+        # arithmetic they'd save).
+        order = jnp.argsort(jnp.where(u, f, jnp.inf))
+        slot = jnp.arange(n_slots)
+        rank_f = jnp.zeros(n_slots, f.dtype).at[order].set(
+            slot.astype(f.dtype))
+        ranki = rank_f.astype(jnp.int32)
+        memb = u & (ranki >= n - k)       # long leg, firm order
+        short_memb = u & (ranki < jnp.minimum(k, n))
+        kf = jnp.maximum(k, 1).astype(r.dtype)
+        long_ret = (r * memb).sum() / kf
+        short_ret = (r * short_memb).sum() / kf
+        # Rank-based Spearman (ops/metrics.py spearman_ic ≡ pearson over
+        # hard ranks): identical tie handling to the reference's stable
+        # double argsort; IC is defined 0 when no target in the month's
+        # universe is observable.
+        ic = jnp.where(tv_any, pearson_ic(rank_f, rank_tgt, u), 0.0)
+        ret_ic = pearson_ic(rank_f, rank_r, u)
+        # Decile profile: bucket = floor(rank·B/n) per firm; per-bucket
+        # sums via a one-hot contraction (a [N, B] compare + reduce beats
+        # segment_sum's scatter-add on every backend tried).
+        bucket = (ranki * n_buckets) // jnp.maximum(n, 1)
+        onehot = (bucket[:, None] == jnp.arange(n_buckets)[None]) \
+            & u[:, None]
+        bsum = (r[:, None] * onehot).sum(axis=0)
+        bcnt = onehot.sum(axis=0)
+        bmean = jnp.where(bcnt > 0, bsum / jnp.maximum(bcnt, 1), 0.0)
+        return {"long_ret": long_ret, "short_ret": short_ret, "ic": ic,
+                "ret_ic": ret_ic, "bmean": bmean, "bhas": bcnt > 0,
+                "memb": memb}
+
+    def turnover_chain(memb, k, used, prev_idx):
+        """Prev-portfolio overlap across USED months (skipped months keep
+        the previous portfolio, exactly like the numpy engine's
+        ``prev_long`` carry). Looks sequential but isn't: each used
+        month's predecessor is resolved OUTSIDE by a cummax over used
+        month indices (``prev_idx``), so the whole chain is one gather +
+        one reduction — a T-step ``lax.scan`` here measured ~150 ms of
+        pure per-iteration overhead on the CPU backend."""
+        prev_memb = memb[jnp.maximum(prev_idx, 0)]          # [T, N]
+        inter = (memb & prev_memb).sum(axis=-1)
+        turn = 1.0 - inter / jnp.maximum(k, 1).astype(jnp.float32)
+        turn_has = used & (prev_idx >= 0)
+        return jnp.where(turn_has, turn, 0.0), turn_has
+
+    def core(scores, u, r, tgt, tv, k_table, min_uni, costs_bps, long_short):
+        """All months × all modes in one dispatch. ``scores`` [G, T, N]
+        (G aggregation modes over a shared universe ``u`` [T, N]). The
+        mode-independent month quantities — universe count, portfolio
+        size, benchmark, target/return ranks — are computed ONCE and
+        broadcast into the per-mode vmap."""
+        n = u.sum(axis=-1)                      # [T]
+        k = k_table[n]
+        used = n >= min_uni
+        bench = (r * u).sum(axis=-1) / jnp.maximum(n, 1).astype(r.dtype)
+        rank_tgt = hard_ranks(tgt, u)           # [T, N], shared by modes
+        rank_r = hard_ranks(r, u)
+        tv_any = (tv & u).any(axis=-1)          # [T]
+        per_month = jax.vmap(month_stats)
+        st = jax.vmap(lambda f: per_month(f, u, r, rank_tgt, rank_r,
+                                          tv_any, n, k))(scores)
+        port = st["long_ret"] - jnp.where(long_short, st["short_ret"], 0.0)
+        # Predecessor used-month index via exclusive cummax: the
+        # vectorized form of the numpy engine's prev_long carry.
+        t_len = used.shape[0]
+        idx = jnp.where(used, jnp.arange(t_len), -1)
+        run = jax.lax.cummax(idx)
+        prev_idx = jnp.concatenate([jnp.full((1,), -1, idx.dtype), run[:-1]])
+        turn, turn_has = jax.vmap(turnover_chain,
+                                  in_axes=(0, None, None, None))(
+            st["memb"], k, used, prev_idx)
+        port = port - costs_bps * 1e-4 * turn * turn_has
+        return {"used": used, "n": n, "k": k, "port": port,
+                "bench": bench, "ic": st["ic"],
+                "ret_ic": st["ret_ic"], "turn": turn, "turn_has": turn_has,
+                "bmean": st["bmean"], "bhas": st["bhas"]}
+
+    return jax.jit(count_traces(f"backtest_core_b{n_buckets}", core))
+
+
+def _dispatch_core(scores, u, panel: Panel, quantile: float,
+                   long_short: bool, min_universe: int, costs_bps: float,
+                   profile_buckets: int) -> dict:
+    """Stage inputs and run the jitted core; returns the host-fetched
+    per-month output dict (one small D2H for everything)."""
+    dev = _device_score_panel(panel)
+    out = _core_for(profile_buckets)(
+        scores, u, dev["returns"], dev["targets"], dev["target_valid"],
+        _k_table(panel.n_firms, quantile),
+        jnp.asarray(min_universe, jnp.int32),
+        jnp.asarray(costs_bps, jnp.float32),
+        jnp.asarray(bool(long_short)),
+    )
+    return jax.device_get(out)
+
+
+def _report_for_mode(out: dict, g: int, dates: np.ndarray, *,
+                     min_universe: int, periods_per_year: int,
+                     rf_monthly: float) -> BacktestReport:
+    """Slice one mode's per-month series out of the core output and hand
+    them to the SHARED report assembly (float64, same as numpy engine)."""
+    used = out["used"]
+    turn_has = out["turn_has"][g]
+    profile = np.where(out["bhas"][g], out["bmean"][g], 0.0)[used]
+    return assemble_report(
+        rets=out["port"][g][used],
+        ics=out["ic"][g][used],
+        ret_ics=out["ret_ic"][g][used],
+        benches=out["bench"][used],
+        turns=out["turn"][g][turn_has],
+        dates=dates[used],
+        skipped=int((~used).sum()),
+        profile_sum=profile.astype(np.float64).sum(axis=0),
+        profile_cnt=out["bhas"][g][used].sum(axis=0),
+        min_universe=min_universe,
+        periods_per_year=periods_per_year,
+        rf_monthly=rf_monthly,
+    )
+
+
+def run_backtest_jax(
+    forecast: np.ndarray,
+    fc_valid: np.ndarray,
+    panel: Panel,
+    quantile: float = 0.1,
+    long_short: bool = False,
+    min_universe: int = 20,
+    periods_per_year: int = 12,
+    rf_monthly: float = 0.0,
+    costs_bps: float = 0.0,
+    profile_buckets: int = 10,
+) -> BacktestReport:
+    """Drop-in fused twin of :func:`engine.run_backtest`: all T months in
+    one jitted dispatch, report math shared with the numpy reference.
+    Matches the numpy engine within float32 tolerance (pinned by the
+    ``backtest``-marked parity suite)."""
+    n, t_len = forecast.shape
+    if panel.returns.shape != (n, t_len):
+        raise ValueError("forecast and panel shapes disagree")
+    dev = _device_score_panel(panel)
+    u = jnp.asarray(np.ascontiguousarray(fc_valid.T)) & dev["tradeable"]
+    scores = jnp.asarray(np.ascontiguousarray(forecast.T))[None]
+    out = _dispatch_core(scores, u, panel, quantile, long_short,
+                         min_universe, costs_bps, profile_buckets)
+    return _report_for_mode(out, 0, panel.dates,
+                            min_universe=min_universe,
+                            periods_per_year=periods_per_year,
+                            rf_monthly=rf_monthly)
+
+
+# ---- device-resident multi-mode aggregation -----------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("kinds",))
+def _aggregate_modes(forecasts, valid, lams, aleatoric_var, kinds):
+    """[S, N, T] stacked forecasts → [G, N, T] scores for every mode in
+    one dispatch. ``kinds`` is the static per-mode penalty selector; λ
+    is traced so a λ sweep reuses the program."""
+    mean = forecasts.mean(axis=0)
+    zeros = jnp.zeros_like(mean)
+    std = tstd = None
+    if any(k == 1 for k in kinds):
+        std = forecasts.std(axis=0)
+    if any(k == 2 for k in kinds):
+        total_var = (forecasts.var(axis=0)
+                     + aleatoric_var.mean(axis=0))
+        tstd = jnp.sqrt(jnp.maximum(total_var, 0.0))
+    penalty = jnp.stack([zeros if k == 0 else (std if k == 1 else tstd)
+                         for k in kinds])
+    scores = mean[None] - lams[:, None, None] * penalty
+    return jnp.where(valid[None], scores, 0.0).astype(jnp.float32)
+
+
+def aggregate_scores_device(
+    forecasts,
+    fc_valid,
+    modes: Sequence[ModeSpec],
+    risk_lambda: float = 1.0,
+    aleatoric_var=None,
+):
+    """Device-resident twin of :func:`engine.aggregate_ensemble` that
+    evaluates ALL aggregation modes from ONE stacked [S, N, T] forecast
+    tensor without re-materializing it per mode.
+
+    Returns ``(scores [G, N, T] device array, valid [N, T] numpy,
+    specs [(mode, λ)])`` — same validation rules and numerics (float32)
+    as the numpy reference, which remains the golden comparison point.
+    """
+    forecasts = jnp.asarray(forecasts)
+    if forecasts.ndim != 3:
+        raise ValueError(f"expected [S, N, T] forecasts, got {forecasts.shape}")
+    specs = normalize_modes(modes, risk_lambda)
+    fc_valid = np.asarray(fc_valid)
+    valid = fc_valid.all(axis=0) if fc_valid.ndim == 3 else fc_valid
+    kinds = tuple(_MODE_KINDS[m] for m, _ in specs)
+    if any(k == 2 for k in kinds):
+        if aleatoric_var is None:
+            raise ValueError(
+                "mean_minus_total_std needs aleatoric_var (predict with "
+                "return_variance=True on a heteroscedastic model)")
+        if aleatoric_var.shape != forecasts.shape:
+            raise ValueError(
+                f"aleatoric_var {aleatoric_var.shape} must match "
+                f"forecasts {forecasts.shape}")
+        avar = jnp.asarray(aleatoric_var)
+    else:
+        # Static zero placeholder: keeps the jitted signature fixed so
+        # mean/std-only sweeps don't re-trace when avar is absent.
+        avar = jnp.zeros((1,) + forecasts.shape[1:], forecasts.dtype)
+    lams = jnp.asarray([lam for _, lam in specs], jnp.float32)
+    scores = _aggregate_modes(forecasts, jnp.asarray(valid), lams, avar,
+                              kinds)
+    return scores, valid, specs
+
+
+def run_scoring_pipeline(
+    forecasts,
+    fc_valid,
+    panel: Panel,
+    modes: Sequence[ModeSpec] = ("mean",),
+    risk_lambda: float = 1.0,
+    aleatoric_var=None,
+    quantile: float = 0.1,
+    long_short: bool = False,
+    min_universe: int = 20,
+    periods_per_year: int = 12,
+    rf_monthly: float = 0.0,
+    costs_bps: float = 0.0,
+    profile_buckets: int = 10,
+) -> Dict[str, BacktestReport]:
+    """Fused aggregate → backtest for a whole mode sweep: ONE aggregation
+    dispatch builds every mode's score panel from the stacked [S, N, T]
+    forecasts, ONE core dispatch backtests all modes × all months, one
+    small D2H fetches the per-month series. Returns {label: report} in
+    spec order (see :func:`mode_label`).
+
+    ``forecasts`` may be [S, N, T] (ensemble seeds / MC-dropout samples)
+    or [N, T] (a single already-aggregated panel: ``mean_minus_std``
+    is rejected there — the seed axis is degenerate, so every λ would
+    silently reproduce "mean" under a penalized label; matches the
+    backtest.py CLI's validation. ``mean_minus_total_std`` stays legal
+    WITH ``aleatoric_var`` — the single-heteroscedastic-model case).
+    """
+    if forecasts.ndim == 2:
+        bad = [m for m, _ in normalize_modes(modes, risk_lambda)
+               if m == "mean_minus_std"]
+        if bad:
+            raise ValueError(
+                "mean_minus_std needs stacked forecasts (ensemble seeds "
+                "or MC-dropout samples); this is a single already-"
+                "aggregated [N, T] panel — its seed-axis std is "
+                "identically 0, so every λ would just relabel 'mean'")
+        forecasts = forecasts[None]
+        if aleatoric_var is not None and aleatoric_var.ndim == 2:
+            aleatoric_var = aleatoric_var[None]
+    scores, valid, specs = aggregate_scores_device(
+        forecasts, fc_valid, modes, risk_lambda, aleatoric_var)
+    dev = _device_score_panel(panel)
+    u = jnp.asarray(np.ascontiguousarray(valid.T)) & dev["tradeable"]
+    out = _dispatch_core(jnp.swapaxes(scores, 1, 2), u, panel, quantile,
+                         long_short, min_universe, costs_bps,
+                         profile_buckets)
+    return {
+        mode_label(mode, lam): _report_for_mode(
+            out, g, panel.dates, min_universe=min_universe,
+            periods_per_year=periods_per_year, rf_monthly=rf_monthly)
+        for g, (mode, lam) in enumerate(specs)
+    }

@@ -505,12 +505,22 @@ def cached_device_panel(panel: Panel, mesh=None, compute_dtype=None,
 
 
 def invalidate_panel(panel: Panel) -> int:
-    """Drop every cached device copy of ``panel`` (all placements/dtypes).
-    The explicit invalidation hook for callers that mutate a panel's
-    arrays in place. Returns the number of entries dropped."""
+    """Drop every cached device copy of ``panel`` (all placements/dtypes)
+    — the TRAINING residency cache here AND the backtest engine's
+    scoring-panel cache (returns/targets/tradeability;
+    backtest/jax_engine.py), so one call covers every device copy a
+    mutated-in-place panel could go stale in. Returns the number of
+    training-cache entries dropped (the reuse tests' counter; scoring
+    entries are dropped on top)."""
     doomed = [k for k in _PANEL_CACHE if k[0] == id(panel)]
     for k in doomed:
         _PANEL_CACHE.pop(k, None)
+    try:
+        from lfm_quant_tpu.backtest.jax_engine import invalidate_score_panel
+
+        invalidate_score_panel(panel)
+    except ImportError:  # scoring engine unavailable — nothing resident
+        pass
     return len(doomed)
 
 

@@ -9,7 +9,7 @@ from lfm_quant_tpu.ops.losses import (
     rank_ic_loss,
     soft_rank,
 )
-from lfm_quant_tpu.ops.metrics import pearson_ic, spearman_ic
+from lfm_quant_tpu.ops.metrics import hard_ranks, pearson_ic, spearman_ic
 
 __all__ = [
     "masked_mse",
@@ -19,6 +19,7 @@ __all__ = [
     "rank_ic_loss",
     "make_loss_parts",
     "finalize_loss",
+    "hard_ranks",
     "pearson_ic",
     "spearman_ic",
 ]

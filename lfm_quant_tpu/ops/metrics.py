@@ -26,14 +26,20 @@ def pearson_ic(pred, target, w):
     return _masked_pearson(pred, target, w)
 
 
-def _hard_ranks(x, w):
+def hard_ranks(x, w):
     """Exact competition-free average ranks of real entries along last axis.
 
     Padded entries are pushed to +inf so they occupy the top rank slots and
     never perturb real entries' ranks; their rank values are meaningless and
     must be masked out by the caller (we multiply by w downstream). Ties get
-    distinct ranks in index order (midranks are not needed for continuous
-    forecasts; exact tie handling documented in tests).
+    distinct ranks in FIRST-INDEX order (``jnp.argsort`` is stable) —
+    the same defined tie-break as the numpy backtest engine's stable
+    double argsort, which is what lets the fused backtest
+    (backtest/jax_engine.py) match the reference exactly on tied
+    forecasts. Public because that engine shares ranks across its IC
+    computations: target/return ranks are computed ONCE per month and
+    paired against each aggregation mode's forecast ranks via
+    :func:`pearson_ic` — ``spearman_ic`` is exactly that composition.
     """
     big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
     xs = jnp.where(w > 0, x, big)
@@ -45,13 +51,16 @@ def _hard_ranks(x, w):
     )
 
 
+_hard_ranks = hard_ranks  # back-compat alias (pre-PR-2 private name)
+
+
 def spearman_ic(pred, target, w):
     """Exact per-month Spearman rank correlation along the last axis.
 
     Matches ``scipy.stats.spearmanr`` on untied data (validated in tests).
     """
-    pr = _hard_ranks(pred, w)
-    tr = _hard_ranks(target, w)
+    pr = hard_ranks(pred, w)
+    tr = hard_ranks(target, w)
     return _masked_pearson(pr, tr, w)
 
 

@@ -48,6 +48,7 @@ from lfm_quant_tpu.parallel import (
 )
 from lfm_quant_tpu.train.checkpoint import CheckpointManager
 from lfm_quant_tpu.train.loop import (
+    _KEEP,
     FitHarness,
     TrainState,
     Trainer,
@@ -81,13 +82,21 @@ class EnsemblePrograms:
         # the vmapped step over (seed × data) — each shard trains its local
         # seed block on its local dates with Pallas kernels intact, psum
         # over 'data' only (seeds are independent).
+        # Donate the stacked TrainState on the multi-step (whole-epoch)
+        # wrapper: at 64-seed scale the un-donated dispatch double-buffers
+        # seeds × (params + both Adam moments) in HBM — see
+        # train/reuse.py multi_step_donate_argnums (LFM_DONATE=0 off).
+        from lfm_quant_tpu.train.reuse import multi_step_donate_argnums
+
+        donate = multi_step_donate_argnums()
         if mesh is None:
             self._vstep = jax.vmap(
                 inner._step_impl, in_axes=(0, None, 0, 0, 0))
             self._jit_step = jax.jit(
                 count_traces("ens_step", self._step_shards))
             self._jit_multi_step = jax.jit(
-                count_traces("ens_multi_step", self._multi_step_impl))
+                count_traces("ens_multi_step", self._multi_step_impl),
+                donate_argnums=donate)
         else:
             # Batch psums cover the data axis and, when present, the seq
             # axis (per-shard sub-window gradients sum to the full-window
@@ -103,10 +112,19 @@ class EnsemblePrograms:
                 self._shard_mapped(self._step_shards, steps_axis=False)))
             self._jit_multi_step = jax.jit(count_traces(
                 "ens_multi_step",
-                self._shard_mapped(self._multi_step_impl, steps_axis=True)))
+                self._shard_mapped(self._multi_step_impl, steps_axis=True)),
+                donate_argnums=donate)
         self._jit_forward = jax.jit(count_traces(
             "ens_forward",
             jax.vmap(inner._forward_impl, in_axes=(0, None, None, None, None))))
+        # Forecast-only twin: predict() consumes nothing but the scores,
+        # so the sweep skips S × M per-month rank-IC/MSE sorts inside the
+        # dispatch (the one-dispatch analog of the batched MC path).
+        self._jit_predict = jax.jit(count_traces(
+            "ens_predict",
+            jax.vmap(functools.partial(inner._forward_impl,
+                                       scores_only=True),
+                     in_axes=(0, None, None, None, None))))
         # Heteroscedastic twin: per-seed (mean, aleatoric variance) for
         # the uncertainty-aware aggregation (mean_minus_total_std).
         self._jit_forward_var = jax.jit(count_traces(
@@ -188,15 +206,17 @@ class EnsembleTrainer:
 
     def rebind(self, cfg: Optional[RunConfig] = None,
                splits: Optional[PanelSplits] = None,
-               run_dir: Optional[str] = None,
+               run_dir: Any = _KEEP,
                echo: Optional[bool] = None) -> "EnsembleTrainer":
         """Re-initialize for the next walk-forward fold: fresh per-seed
         sampler orders, new split boundaries/run dir, stacked TrainState
         dropped — without rebuilding the vmapped jit wrappers when the
-        program key is unchanged (see Trainer.rebind). Returns self."""
+        program key is unchanged (see Trainer.rebind; an omitted
+        ``run_dir`` keeps the previous one, explicit None drops it).
+        Returns self."""
         self._setup(cfg if cfg is not None else self.cfg,
                     splits if splits is not None else self.splits,
-                    run_dir,
+                    self.run_dir if run_dir is _KEEP else run_dir,
                     self.echo if echo is None else echo)
         return self
 
@@ -292,6 +312,7 @@ class EnsembleTrainer:
         self._jit_step = p._jit_step
         self._jit_multi_step = p._jit_multi_step
         self._jit_forward = p._jit_forward
+        self._jit_predict = p._jit_predict
         self._jit_forward_var = p._jit_forward_var
 
     # ---- program delegates (back-compat; see Trainer's) --------------
@@ -467,7 +488,9 @@ class EnsembleTrainer:
             pred, var, _ = self._jit_forward_var(
                 self.state.params, self.dev, fi, ti, w)
         else:
-            pred, _, _ = self._jit_forward(
+            # Forecast-only dispatch: ONE vmapped forward for all seeds
+            # with the per-month metrics compiled out, ONE D2H below.
+            pred, _, _ = self._jit_predict(
                 self.state.params, self.dev, fi, ti, w)
         pred = np.asarray(pred)  # [S, M, bf]
         real = b.weight > 0  # [M, bf]

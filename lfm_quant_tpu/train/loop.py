@@ -309,10 +309,21 @@ class TrainerPrograms:
         self.tx = optax.chain(
             optax.clip_by_global_norm(cfg.optim.grad_clip), opt)
 
+        # The multi-step (whole-epoch) wrappers donate their TrainState:
+        # fit() consumes states linearly, so XLA aliases params/opt_state
+        # in place instead of double-buffering them in HBM across the
+        # epoch-long dispatch (train/reuse.py multi_step_donate_argnums
+        # has the safety argument; LFM_DONATE=0 is the kill switch). The
+        # single-step wrappers stay un-donated — they are the numerical
+        # A/B surface and tests re-dispatch one state on purpose.
+        from lfm_quant_tpu.train.reuse import multi_step_donate_argnums
+
+        donate = multi_step_donate_argnums()
         if mesh is None:
             self._jit_step = jax.jit(count_traces("step", self._step_impl))
             self._jit_multi_step = jax.jit(
-                count_traces("multi_step", self._multi_step_impl))
+                count_traces("multi_step", self._multi_step_impl),
+                donate_argnums=donate)
         else:
             # shard_map over the date axis: each shard gathers and runs the
             # model locally (Pallas kernels legal), with explicit psums for
@@ -322,10 +333,24 @@ class TrainerPrograms:
                 self._step_impl, steps_axis=False)))
             self._jit_multi_step = jax.jit(count_traces(
                 "multi_step",
-                self._shard_mapped(self._multi_step_impl, steps_axis=True)))
+                self._shard_mapped(self._multi_step_impl, steps_axis=True)),
+                donate_argnums=donate)
         self._jit_forward = jax.jit(
             count_traces("forward", self._forward_impl),
             static_argnames=("variance",))
+        # Batched MC-dropout: the eval forward vmapped over a stacked key
+        # array, so K samples are ONE dispatch (and ONE D2H in predict)
+        # instead of K serial dispatches each paying tunnel latency.
+        self._jit_mc_forward = jax.jit(count_traces(
+            "mc_forward", self._mc_forward_impl))
+        # Forecast-only twin (scores_only): predict() consumes nothing
+        # but the scores, so the serving sweep skips M wasted per-month
+        # rank-IC sorts + MSE inside the dispatch — the single-seed
+        # analog of the ensemble's _jit_predict.
+        self._jit_predict = jax.jit(count_traces(
+            "predict",
+            lambda params, dev, fi, ti, w: self._forward_impl(
+                params, dev, fi, ti, w, scores_only=True)))
         # Month-sharded eval: under a data mesh the plain jitted forward
         # would replicate the whole sweep on every device; shard_map over
         # the stacked month axis makes eval/backtest scale with the data
@@ -501,8 +526,48 @@ class TrainerPrograms:
 
         return jax.lax.scan(body, state, (fi, ti, w))
 
+    def _mc_forward_impl(self, params, dev: dict, firm_idx, time_idx,
+                         keys):
+        """Batched MC-dropout eval forward: K samples in ONE dispatch.
+
+        The window gather is SAMPLE-INVARIANT (every sample reads the
+        same [M, bf] indices), so each chunk gathers once and only the
+        model apply is vmapped over the stacked key axis — K× fewer
+        gather bytes than vmapping the whole eval forward, and K× fewer
+        dispatches than the per-sample loop it replaces. Key derivation
+        matches the loop path exactly (per-sample key → per-chunk
+        split), so the two paths draw identical dropout masks and
+        ``predict`` replays are seed-stable on either.
+        Returns stacked forecasts [K, M, bf].
+        """
+        M = firm_idx.shape[0]
+        C = min(self.cfg.data.dates_per_batch, M)
+        pad = (-M) % C
+        if pad:
+            firm_idx = jnp.concatenate([firm_idx, firm_idx[:pad]], axis=0)
+            time_idx = jnp.concatenate([time_idx, time_idx[:pad]], axis=0)
+        nc = firm_idx.shape[0] // C
+        k_samples = keys.shape[0]
+        # [K, nc] → [nc, K]: lax.map consumes the chunk axis first.
+        chunk_keys = jnp.swapaxes(
+            jax.vmap(lambda kk: jax.random.split(kk, nc))(keys), 0, 1)
+
+        def chunk(args):
+            fi, ti, kks = args
+            x, m = self._gather(dev["xm"], fi, ti,
+                                impl=self._eval_gather_impl)
+            return jax.vmap(lambda kk: _point_forecast(self._apply(
+                params, x, m, model=self.eval_model, rng=kk)))(kks)
+
+        pred = jax.lax.map(chunk, (firm_idx.reshape(nc, C, -1),
+                                   time_idx.reshape(nc, C), chunk_keys))
+        # [nc, K, C, bf] → [K, nc·C, bf], padding sliced off.
+        return jnp.moveaxis(pred, 0, 1).reshape(
+            k_samples, nc * C, -1)[:, :M]
+
     def _forward_impl(self, params, dev: dict, firm_idx, time_idx, weight,
-                      rng=None, variance: bool = False, axis=None):
+                      rng=None, variance: bool = False, axis=None,
+                      scores_only: bool = False):
         """Eval forward: returns (pred [D,Bf], per-month IC [D], mse scalar).
 
         Chunked over the date axis with ``lax.map``: eval sweeps stack ALL
@@ -517,7 +582,11 @@ class TrainerPrograms:
         (pred, IC, mse) — the uncertainty-aware-LFM prediction path
         (SURVEY.md §1 lineage). ``axis``: mesh axis name when running
         inside the month-sharded eval ``shard_map`` — the mse parts psum
-        over it so the scalar replicates.
+        over it so the scalar replicates. ``scores_only`` (static) skips
+        the per-month IC/MSE metrics like the sampling path does —
+        prediction sweeps only consume the forecasts, and an S-seed
+        ensemble predict would otherwise pay S × M wasted rank sorts in
+        the dispatch.
         """
         if variance and rng is not None:
             raise ValueError("variance + MC-dropout sampling not supported")
@@ -551,9 +620,9 @@ class TrainerPrograms:
                 mean, log_var = out
                 return mean, jnp.exp(log_var.astype(jnp.float32))
             pred = _point_forecast(out)
-            if rng is not None:
-                # Sampling path: only the forecasts are consumed — skip
-                # the per-month ranking/error metrics K times over.
+            if rng is not None or scores_only:
+                # Sampling / forecast-only path: only the forecasts are
+                # consumed — skip the per-month ranking/error metrics.
                 return pred
             y = gather_targets(dev["targets"], fi, ti)
             ic = spearman_ic(pred, y, w)
@@ -564,7 +633,7 @@ class TrainerPrograms:
             mean, var = jax.lax.map(chunk, tuple(chunks))
             return (mean.reshape(nc * C, -1)[:M],
                     var.reshape(nc * C, -1)[:M], None)
-        if rng is not None:
+        if rng is not None or scores_only:
             pred = jax.lax.map(chunk, tuple(chunks))
             return pred.reshape(nc * C, -1)[:M], None, None
         pred, ic, se, ws = jax.lax.map(chunk, tuple(chunks))
@@ -577,6 +646,11 @@ class TrainerPrograms:
             ws_sum = jax.lax.psum(ws_sum, axis)
         mse = se_sum / jnp.maximum(ws_sum, 1e-12)
         return pred, ic, mse
+
+
+#: rebind() sentinel: "keep the previous run_dir" (explicit None means
+#: "drop it" — a fold that must not checkpoint).
+_KEEP = object()
 
 
 class Trainer:
@@ -603,17 +677,20 @@ class Trainer:
 
     def rebind(self, cfg: Optional[RunConfig] = None,
                splits: Optional[PanelSplits] = None,
-               run_dir: Optional[str] = None,
+               run_dir: Any = _KEEP,
                echo: Optional[bool] = None) -> "Trainer":
         """Re-initialize this trainer for the next walk-forward fold:
         fresh sampler seeds and split boundaries, new run dir, TrainState
         dropped — WITHOUT rebuilding the jit wrappers (the program key is
         recomputed; an unchanged key keeps the exact same executables and
         device panel, a changed one fetches/builds through the cache like
-        a fresh construction would). Returns self."""
+        a fresh construction would). Like the other parameters, an
+        OMITTED ``run_dir`` keeps the previous one (checkpointing must
+        not silently vanish on a partial rebind); pass ``run_dir=None``
+        explicitly to drop it. Returns self."""
         self._setup(cfg if cfg is not None else self.cfg,
                     splits if splits is not None else self.splits,
-                    run_dir,
+                    self.run_dir if run_dir is _KEEP else run_dir,
                     self.echo if echo is None else echo,
                     "auto")
         return self
@@ -784,6 +861,8 @@ class Trainer:
         self._jit_step = p._jit_step
         self._jit_multi_step = p._jit_multi_step
         self._jit_forward = p._jit_forward
+        self._jit_mc_forward = p._jit_mc_forward
+        self._jit_predict = p._jit_predict
         self._jit_fwd_det = p._jit_fwd_det
         self._jit_fwd_var = p._jit_fwd_var
 
@@ -977,7 +1056,8 @@ class Trainer:
 
     def predict(self, split: str = "test", mc_samples: int = 0,
                 mc_seed: int = 0, date_range: Optional[Tuple[int, int]] = None,
-                return_variance: bool = False, require_target: bool = True):
+                return_variance: bool = False, require_target: bool = True,
+                mc_batched: Optional[bool] = None):
         """Forecasts for every eligible anchor in a split's date range.
 
         Returns (forecast [N, T] float32, pred_valid [N, T] bool) over the
@@ -992,11 +1072,18 @@ class Trainer:
 
         ``mc_samples > 0`` switches to **MC-dropout sampling** (the
         uncertainty-aware LFM lineage's single-model alternative to deep
-        ensembles, SURVEY.md §1 [BACKGROUND]): the forward runs that many
-        times with dropout live and independent keys, returning stacked
+        ensembles, SURVEY.md §1 [BACKGROUND]): the eval forward runs with
+        dropout live under K independent keys, returning stacked
         forecasts ``[K, N, T]`` shaped exactly like
         ``EnsembleTrainer.predict`` so ``aggregate_ensemble`` (mean /
         mean−λ·std) consumes either. Requires a model with dropout > 0.
+        By default all K samples run as ONE vmapped dispatch with ONE
+        device→host copy (the key array is the vmapped axis);
+        ``mc_batched=False`` — or ``LFM_MC_BATCHED=0`` — keeps the
+        per-sample dispatch loop (the A/B baseline, and the escape hatch
+        for gathers whose batching rule can't ride an extra vmap axis).
+        Both paths scatter the stacked ``[K, M, bf]`` result into the
+        panel in a single vectorized assignment.
 
         ``date_range`` (month-INDEX pair, end-exclusive) overrides the
         split's anchor range — the walk-forward harness predicts each
@@ -1033,17 +1120,33 @@ class Trainer:
                 raise ValueError(
                     "return_variance is not combinable with mc_samples — "
                     "MC sampling already carries the uncertainty")
-            # Same jitted eval forward; the 6-arg (rng) signature gets its
-            # own cached trace with dropout live and metrics skipped.
-            out = np.zeros((mc_samples, panel.n_firms, panel.n_months),
-                           np.float32)
+            if mc_batched is None:
+                mc_batched = os.environ.get("LFM_MC_BATCHED", "1") != "0"
             fi, ti, w = self._batch_args(b)
             key = jax.random.key(mc_seed)
-            for k in range(mc_samples):
-                pred, _, _ = self._jit_forward(
-                    self.state.params, self.dev, fi, ti, w,
-                    jax.random.fold_in(key, k))
-                out[k][rows, cols] = np.asarray(pred)[real]
+            if mc_batched:
+                # ONE dispatch: per-chunk gather shared by all samples,
+                # model apply vmapped over the stacked key array (keys
+                # derived exactly like the loop path, so replay is
+                # seed-stable either way), ONE D2H of [K, M, bf].
+                keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+                    jnp.arange(mc_samples))
+                pred = np.asarray(self._jit_mc_forward(
+                    self.state.params, self.dev, fi, ti, keys))
+            else:
+                # Fallback loop: one dispatch per sample (the 6-arg rng
+                # signature of the shared eval forward), stacked on host.
+                pred = np.stack([
+                    np.asarray(self._jit_forward(
+                        self.state.params, self.dev, fi, ti, w,
+                        jax.random.fold_in(key, k))[0])
+                    for k in range(mc_samples)])
+            # Single vectorized scatter for the whole [K, M, bf] stack —
+            # the per-sample fancy-indexing loop this replaces rewrote
+            # rows/cols K times over.
+            out = np.zeros((mc_samples, panel.n_firms, panel.n_months),
+                           np.float32)
+            out[:, rows, cols] = pred[:, real]
             return out, out_valid
 
         out = np.zeros((panel.n_firms, panel.n_months), np.float32)
@@ -1054,7 +1157,15 @@ class Trainer:
             out[rows, cols] = np.asarray(pred)[real]
             var_out[rows, cols] = np.asarray(var)[real]
             return out, var_out, out_valid
-        pred, _, _ = self._forward_eval(self.state.params, b)
+        if self._eval_sharded:
+            # Month-sharded path keeps the shared det program (its psum
+            # structure is part of the sharded executable).
+            pred, _, _ = self._forward_eval(self.state.params, b)
+        else:
+            # Forecast-only dispatch: per-month metrics compiled out.
+            pred, _, _ = self._jit_predict(
+                self.state.params, self.dev, jnp.asarray(b.firm_idx),
+                jnp.asarray(b.time_idx), jnp.asarray(b.weight))
         out[rows, cols] = np.asarray(pred)[real]
         return out, out_valid
 

@@ -78,6 +78,34 @@ def reuse_enabled() -> bool:
     return os.environ.get("LFM_PROGRAM_REUSE", "1") != "0"
 
 
+def donation_enabled() -> bool:
+    """Buffer-donation kill switch: ``LFM_DONATE=0`` turns off
+    ``donate_argnums`` on the multi-step wrappers (the pre-donation
+    double-buffered path — the A/B switch for the donation regression
+    test and an escape hatch for platforms where XLA cannot alias)."""
+    return os.environ.get("LFM_DONATE", "1") != "0"
+
+
+def multi_step_donate_argnums() -> Tuple[int, ...]:
+    """``donate_argnums`` for the jitted MULTI-step wrappers: the
+    TrainState argument (position 0) is donated so XLA aliases the
+    input params/opt_state buffers into the outputs instead of double-
+    buffering them in HBM for the whole epoch-long dispatch — at c5
+    ensemble scale that is a full extra copy of 64 seeds × (params +
+    two Adam moments). Donation is applied ONLY to the multi-step
+    wrappers: ``fit`` consumes states linearly (the returned state
+    replaces the input), while the SINGLE-step wrappers are the
+    numerical-A/B surface (tests re-dispatch one state on purpose) and
+    run one step per dispatch, where the transient double-buffer is
+    bounded by one step's activations anyway.
+
+    Guarded by the reuse zero-trace contract: donation changes the
+    executable's aliasing metadata, not its trace — the ``reuse``-lane
+    tests assert warm folds still pay zero traces, and the donation
+    check asserts the input state is actually consumed."""
+    return (0,) if donation_enabled() else ()
+
+
 def freeze(obj):
     """Recursively convert ``obj`` into a hashable cache-key component
     (dicts → sorted item tuples, lists/tuples → tuples)."""
@@ -125,6 +153,10 @@ def trainer_program_key(cfg, mesh, n_seq: int, gather_impl: str,
         # Data geometry reaching traces as constants.
         (d.window, d.dates_per_batch),
         (gather_impl, eval_gather_impl, eval_gather_sharded, fp),
+        # Donation changes the executables' aliasing metadata: a bundle
+        # built with donation on must not be served to a trainer
+        # constructed under LFM_DONATE=0 (and vice versa).
+        donation_enabled(),
     )
 
 

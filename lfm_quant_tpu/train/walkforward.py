@@ -32,7 +32,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -87,6 +87,67 @@ def walkforward_folds(panel: Panel, start: int, step_months: int,
     return folds
 
 
+def _report_scalars(rep) -> Dict[str, Any]:
+    """JSON-friendly digest of a BacktestReport: every scalar field plus
+    the one-line summary (the monthly arrays stay out of summary.json —
+    the stitched npz already carries the underlying panel)."""
+    digest = {
+        k: v for k, v in dataclasses.asdict(rep).items()
+        if isinstance(v, (int, float))
+    }
+    digest["summary"] = rep.summary()
+    return digest
+
+
+def score_stitched(forecast: np.ndarray, valid: np.ndarray, panel: Panel,
+                   score_modes: Sequence, variance=None,
+                   **backtest_kw) -> Dict[str, Any]:
+    """Grade a stitched out-of-sample forecast panel over an aggregation-
+    mode grid through the device-resident scoring path.
+
+    With ``LFM_JAX_BACKTEST`` on (the default), ALL modes are aggregated
+    from one stacked tensor and backtested in ONE fused dispatch
+    (backtest/jax_engine.py); otherwise each mode takes the numpy
+    reference path — identical reports either way, within float32
+    tolerance (the parity suite's contract). Returns
+    {mode label: report digest}.
+    """
+    from lfm_quant_tpu.backtest import jax_backtest_enabled
+    from lfm_quant_tpu.backtest.engine import mode_label, normalize_modes
+
+    kw = dict(backtest_kw)
+    specs = normalize_modes(score_modes, kw.pop("risk_lambda", 1.0))
+    if forecast.ndim == 2 and any(m == "mean_minus_std" for m, _ in specs):
+        # Same rule as the backtest.py CLI: a single stitched model has a
+        # degenerate seed axis — every λ would silently relabel "mean".
+        raise ValueError(
+            "mean_minus_std needs stacked forecasts (n_seeds > 1 walk-"
+            "forward); this sweep stitched a single model's panel")
+    stacked = forecast if forecast.ndim == 3 else forecast[None]
+    avar = None
+    if variance is not None:
+        avar = variance if variance.ndim == 3 else variance[None]
+    reports = None
+    if jax_backtest_enabled():
+        try:
+            from lfm_quant_tpu.backtest.jax_engine import run_scoring_pipeline
+
+            reports = run_scoring_pipeline(stacked, valid, panel,
+                                           modes=specs, aleatoric_var=avar,
+                                           **kw)
+        except ImportError:
+            reports = None  # no jax on this host — numpy fallback below
+    if reports is None:
+        from lfm_quant_tpu.backtest import aggregate_ensemble, run_backtest
+
+        reports = {}
+        for mode, lam in specs:
+            fc, v = aggregate_ensemble(stacked, valid, mode, lam,
+                                       aleatoric_var=avar)
+            reports[mode_label(mode, lam)] = run_backtest(fc, v, panel, **kw)
+    return {label: _report_scalars(rep) for label, rep in reports.items()}
+
+
 def _load_fold_best_params(trainer, fold_dir: str):
     """Best params of a previously-completed fold, restored from its
     ``ckpt/best`` line — the warm-start carry for folds whose in-memory
@@ -123,7 +184,9 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                     n_folds: Optional[int] = None, out_dir: Optional[str] = None,
                     echo: bool = False, resume: bool = False,
                     warm_start: bool = False,
-                    train_months: Optional[int] = None
+                    train_months: Optional[int] = None,
+                    score_modes: Optional[Sequence] = None,
+                    score_kwargs: Optional[Dict[str, Any]] = None
                     ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
     """Train a model (or seed ensemble, ``cfg.n_seeds > 1``) per fold and
     stitch the out-of-sample forecasts.
@@ -167,6 +230,18 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     restores them from the predecessor fold dir's ``ckpt/best``
     (falling back to a fresh init, with a warning, only when that
     checkpoint line is missing).
+
+    ``score_modes``: when set, the stitched out-of-sample panel is graded
+    END-OF-SWEEP through the device-resident scoring path
+    (backtest/jax_engine.py ``run_scoring_pipeline`` when
+    ``LFM_JAX_BACKTEST`` is on, the numpy engine otherwise): every listed
+    aggregation mode — names or explicit ``(mode, λ)`` pairs, the
+    uncertainty_aggregation sweep's grid — is evaluated from ONE stacked
+    forecast tensor and backtested in one fused dispatch.
+    ``summary["backtest"]`` maps each mode label to the report's summary
+    dict (and the full reports land in ``summary.json``). Single-model
+    sweeps accept only ["mean"]; ``score_kwargs`` forwards backtest knobs
+    (quantile, long_short, costs_bps, ...).
 
     ``train_months``: rolling train window length in months (None =
     expanding window, the reference protocol — every fold trains on all
@@ -335,6 +410,14 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                        int(panel.dates[folds[-1][2][1] - 1])],
         "folds": records,
     }
+    def _save_summary():
+        if out_dir:
+            with open(os.path.join(out_dir, "summary.json"), "w") as fh:
+                json.dump(summary, fh, indent=2)
+
+    # Persist the sweep's primary artifacts BEFORE end-of-sweep grading:
+    # a scoring failure (bad score_kwargs, device OOM) must never
+    # discard hours of trained folds' stitched forecasts.
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
         extra = {"variance": variance} if het else {}
@@ -342,6 +425,14 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                             forecast=forecast, valid=valid, **extra)
         with open(os.path.join(out_dir, "config.json"), "w") as fh:
             fh.write(cfg.to_json())
-        with open(os.path.join(out_dir, "summary.json"), "w") as fh:
-            json.dump(summary, fh, indent=2)
+        _save_summary()
+    if score_modes:
+        # End-of-sweep grading of the stitched strictly-out-of-sample
+        # panel through the fused scoring path (numpy fallback when the
+        # LFM_JAX_BACKTEST knob is off); only summary.json needs the
+        # re-write (the npz would just recompress identical arrays).
+        summary["backtest"] = score_stitched(
+            forecast, valid, panel, score_modes, variance=variance,
+            **(score_kwargs or {}))
+        _save_summary()
     return forecast, valid, summary

@@ -63,7 +63,18 @@ def main():
     fi, ti, w = trainer._batch_args(b, train=True, steps=True)
     fm = float(b.weight.sum()) * trainer.window
 
-    t_full = timeit(lambda: trainer._jit_multi_step(state, trainer.dev, fi, ti, w))
+    # The multi-step wrapper DONATES its state (train/reuse.py): thread
+    # the returned state through a holder so each rep consumes the
+    # previous rep's output instead of a deleted buffer.
+    state_box = [state]
+
+    def full_step():
+        st, ms = trainer._jit_multi_step(state_box[0], trainer.dev,
+                                         fi, ti, w)
+        state_box[0] = st
+        return ms
+
+    t_full = timeit(full_step)
     print(f"full multi-step ({k} steps): {t_full*1e3:.1f} ms  "
           f"-> {fm/t_full/1e6:.1f} M fm/s")
 
@@ -81,7 +92,7 @@ def main():
             return c, trainer.loss_fn(out, y, bw)
         return jax.lax.scan(body, 0, (fi, ti, w))
 
-    t_fwd = timeit(lambda: fwd_scan(state.params, trainer.dev, fi, ti, w))
+    t_fwd = timeit(lambda: fwd_scan(state_box[0].params, trainer.dev, fi, ti, w))
     print(f"fwd+loss scan: {t_fwd*1e3:.1f} ms ({t_fwd/t_full*100:.0f}% of full)")
 
     @jax.jit
@@ -105,7 +116,7 @@ def main():
     def model_only(params, x, m):
         return trainer._apply(params, x, m)
 
-    t_m = timeit(lambda: model_only(state.params, x, m), reps=10)
+    t_m = timeit(lambda: model_only(state_box[0].params, x, m), reps=10)
     per_batch_full = t_full / k
     print(f"model fwd single batch [{x.shape[0]}x{x.shape[1]}]: {t_m*1e3:.2f} ms "
           f"(full step avg {per_batch_full*1e3:.2f} ms)")
@@ -116,7 +127,7 @@ def main():
             : x.shape[0] * mult]
         mm = jnp.tile(m, (mult, 1, 1)).reshape((-1,) + m.shape[1:])[
             : m.shape[0] * mult]
-        t = timeit(lambda: model_only(state.params, xx, mm), reps=5)
+        t = timeit(lambda: model_only(state_box[0].params, xx, mm), reps=5)
         print(f"model fwd batch x{mult} [{xx.shape[0]}]: {t*1e3:.2f} ms "
               f"({t/t_m:.2f}x time for {mult}x work)")
 

@@ -176,3 +176,33 @@ def test_mc_predict_validity_matches_plain(splits, tmp_path):
     _, v_mc = t.predict("test", mc_samples=2)
     _, v = t.predict("test")
     np.testing.assert_array_equal(v_mc, v)
+
+
+def test_mc_predict_batched_is_one_dispatch_and_matches_loop(splits,
+                                                            tmp_path):
+    """The batched MC path (default) draws bit-identical samples to the
+    per-sample loop fallback (shared key derivation: per-sample fold_in →
+    per-chunk split), and K samples cost ONE trace on first use and ZERO
+    on repeat — the 1-compile/1-dispatch contract of the fused scoring
+    pipeline."""
+    from lfm_quant_tpu.data.windows import clear_panel_cache
+    from lfm_quant_tpu.train import reuse
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    # Fresh program bundle: sibling tests share the cached wrappers (and
+    # their already-traced executables), which would zero the counter.
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    t = _fitted(splits, tmp_path / "mc1d", 0.5)
+    loop, v_loop = t.predict("test", mc_samples=3, mc_seed=5,
+                             mc_batched=False)
+    snap = REUSE_COUNTERS.snapshot()
+    batched, v_b = t.predict("test", mc_samples=3, mc_seed=5,
+                             mc_batched=True)
+    assert REUSE_COUNTERS.delta(snap)["jit_traces"] == 1  # mc_forward
+    np.testing.assert_array_equal(v_loop, v_b)
+    np.testing.assert_array_equal(loop, batched)
+    snap = REUSE_COUNTERS.snapshot()
+    again, _ = t.predict("test", mc_samples=3, mc_seed=5, mc_batched=True)
+    assert REUSE_COUNTERS.delta(snap)["jit_traces"] == 0
+    np.testing.assert_array_equal(batched, again)

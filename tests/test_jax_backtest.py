@@ -1,0 +1,231 @@
+"""numpy ↔ JAX backtest parity: the fused device-resident scoring path
+(backtest/jax_engine.py) against the numpy reference engine.
+
+The fused engine is an OPTIMIZATION, never a numerics change: portfolios
+are bit-identical (stable-sort tie-break + host-precomputed k-table),
+per-month series match within float32 tolerance, and the report summary
+math is literally shared (``engine.assemble_report``). These tests are
+the ``backtest`` marker lane (``pytest -m backtest -q``) — the fast CI
+guard that a core refactor can't silently diverge the two engines.
+"""
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.backtest import (
+    jax_backtest_enabled,
+    resolve_backtest,
+    run_backtest,
+)
+from lfm_quant_tpu.backtest.engine import aggregate_ensemble, mode_label
+from lfm_quant_tpu.backtest.jax_engine import (
+    aggregate_scores_device,
+    run_backtest_jax,
+    run_scoring_pipeline,
+)
+from lfm_quant_tpu.data.panel import Panel
+
+pytestmark = [pytest.mark.backtest, pytest.mark.fast]
+
+# float32-tolerance contract: returns/bench/profile are sums of a few
+# hundred float32 terms; ICs additionally square rank magnitudes (~n²),
+# so they get the loosest bound.
+TOL = dict(ret=2e-6, ic=5e-4, profile=2e-6, turn=1e-6)
+
+
+def random_panel(n=80, t=90, seed=0, ragged=True):
+    """Adversarial panel: ragged live spans, vendor gaps, unobserved
+    targets, delisting-censored forward returns."""
+    rng = np.random.default_rng(seed)
+    feats = rng.standard_normal((n, t, 2)).astype(np.float32)
+    valid = np.ones((n, t), bool)
+    if ragged:
+        lo = rng.integers(0, t // 3, n)
+        hi = rng.integers(2 * t // 3, t + 1, n)
+        cols = np.arange(t)
+        valid = (cols >= lo[:, None]) & (cols < hi[:, None])
+        valid &= rng.random((n, t)) > 0.05
+    tv = valid & (rng.random((n, t)) > 0.3)
+    targets = np.where(tv, rng.standard_normal((n, t)), 0.0).astype(np.float32)
+    returns = np.where(valid, 0.02 * rng.standard_normal((n, t)),
+                       0.0).astype(np.float32)
+    ret_valid = valid.copy()
+    ret_valid &= rng.random((n, t)) > 0.1
+    y, m = 2000 + np.arange(t) // 12, np.arange(t) % 12 + 1
+    return Panel(feats, targets, tv, valid, returns,
+                 (y * 100 + m).astype(np.int32),
+                 np.arange(1, n + 1, dtype=np.int32), ["a", "b"],
+                 horizon=1, ret_valid=ret_valid)
+
+
+def assert_reports_match(a, b):
+    """Field-by-field parity of the numpy (a) and fused (b) reports."""
+    assert a.n_months == b.n_months
+    assert a.n_skipped_months == b.n_skipped_months
+    np.testing.assert_array_equal(a.dates, b.dates)
+    np.testing.assert_allclose(a.monthly_returns, b.monthly_returns,
+                               atol=TOL["ret"])
+    np.testing.assert_allclose(a.monthly_bench, b.monthly_bench,
+                               atol=TOL["ret"])
+    np.testing.assert_allclose(a.monthly_ic, b.monthly_ic, atol=TOL["ic"])
+    np.testing.assert_allclose(a.quantile_profile, b.quantile_profile,
+                               atol=TOL["profile"])
+    np.testing.assert_allclose(a.turnover, b.turnover, atol=TOL["turn"])
+    np.testing.assert_allclose(a.mean_ic, b.mean_ic, atol=TOL["ic"])
+    np.testing.assert_allclose(a.mean_ret_ic, b.mean_ret_ic, atol=TOL["ic"])
+    np.testing.assert_allclose(a.cagr, b.cagr, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(a.sharpe_ann, b.sharpe_ann, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_parity_property_random_panels():
+    """Property-style sweep over random ragged panels × engine configs,
+    forecasts quantized to force TIES across the portfolio boundary —
+    the case an unstable sort order would silently diverge on."""
+    for seed in range(4):
+        panel = random_panel(seed=seed)
+        rng = np.random.default_rng(100 + seed)
+        fc = rng.standard_normal(panel.targets.shape).astype(np.float32)
+        fc = np.round(fc * 3) / 3  # heavy ties
+        fc_valid = panel.valid & (rng.random(fc.shape) > 0.2)
+        fc_valid[:, 7] = False  # an empty month
+        for kw in (dict(min_universe=10),
+                   dict(min_universe=10, long_short=True, costs_bps=25.0),
+                   dict(min_universe=10, quantile=0.25, rf_monthly=0.002),
+                   dict(min_universe=40)):  # short universes skip months
+            a = run_backtest(fc, fc_valid, panel, **kw)
+            b = run_backtest_jax(fc, fc_valid, panel, **kw)
+            assert_reports_match(a, b)
+
+
+def test_parity_all_invalid_target_months():
+    """Months whose universe has no observable target define IC = 0 on
+    both engines (not NaN, not dropped)."""
+    panel = random_panel(seed=9)
+    panel.target_valid[:, 20:30] = False
+    rng = np.random.default_rng(1)
+    fc = rng.standard_normal(panel.targets.shape).astype(np.float32)
+    a = run_backtest(fc, panel.valid, panel, min_universe=10)
+    b = run_backtest_jax(fc, panel.valid, panel, min_universe=10)
+    assert_reports_match(a, b)
+    # The blinded months really hit the IC=0 branch on both engines.
+    blinded = (a.dates >= a.dates.min()) & np.isin(
+        a.dates, panel.dates[20:30].astype(a.dates.dtype))
+    assert blinded.any() and np.all(a.monthly_ic[blinded] == 0.0)
+    assert np.all(b.monthly_ic[blinded] == 0.0)
+
+
+def test_parity_thin_and_tiny_universes():
+    """Universes below profile_buckets exercise the thin-month bucket
+    mapping; min_universe=1 keeps them in the simulation."""
+    panel = random_panel(n=8, t=60, seed=3, ragged=False)
+    rng = np.random.default_rng(2)
+    fc = rng.standard_normal(panel.targets.shape).astype(np.float32)
+    a = run_backtest(fc, panel.valid, panel, min_universe=1, quantile=0.2)
+    b = run_backtest_jax(fc, panel.valid, panel, min_universe=1,
+                         quantile=0.2)
+    assert_reports_match(a, b)
+
+
+def test_jax_engine_raises_when_no_month_qualifies():
+    panel = random_panel(seed=5)
+    fc = np.zeros(panel.targets.shape, np.float32)
+    with pytest.raises(ValueError, match="no month"):
+        run_backtest_jax(fc, np.zeros(fc.shape, bool), panel)
+
+
+def test_aggregate_scores_device_matches_numpy():
+    """All modes from one stacked tensor ≡ the numpy per-mode aggregate,
+    including the aleatoric total-std mode and per-seed validity."""
+    rng = np.random.default_rng(4)
+    fc = rng.standard_normal((5, 30, 24)).astype(np.float32)
+    avar = rng.random((5, 30, 24)).astype(np.float32)
+    pv = np.ones((5, 30, 24), bool)
+    pv[2, 4, 4] = False
+    modes = [("mean", 1.0), ("mean_minus_std", 0.5),
+             ("mean_minus_std", 2.0), ("mean_minus_total_std", 1.0)]
+    scores, valid, specs = aggregate_scores_device(fc, pv, modes,
+                                                   aleatoric_var=avar)
+    scores = np.asarray(scores)
+    assert scores.shape == (4, 30, 24)
+    for g, (mode, lam) in enumerate(specs):
+        ref, ref_valid = aggregate_ensemble(
+            fc, pv, mode, lam,
+            aleatoric_var=avar if mode == "mean_minus_total_std" else None)
+        np.testing.assert_array_equal(valid, ref_valid)
+        np.testing.assert_allclose(scores[g], ref, atol=1e-5)
+    with pytest.raises(ValueError, match="aleatoric_var"):
+        aggregate_scores_device(fc, pv, ["mean_minus_total_std"])
+    with pytest.raises(ValueError, match="unknown ensemble mode"):
+        aggregate_scores_device(fc, pv, ["median"])
+
+
+def test_scoring_pipeline_matches_per_mode_numpy_path():
+    """The fused mode-sweep (one aggregate dispatch + one backtest
+    dispatch for ALL modes) ≡ numpy aggregate_ensemble → run_backtest
+    per mode."""
+    panel = random_panel(seed=6)
+    rng = np.random.default_rng(7)
+    stack = rng.standard_normal((4,) + panel.targets.shape).astype(np.float32)
+    modes = [("mean", 1.0), ("mean_minus_std", 0.5), ("mean_minus_std", 2.0)]
+    reports = run_scoring_pipeline(stack, panel.valid, panel, modes=modes,
+                                   min_universe=10)
+    assert list(reports) == [mode_label(m, lam) for m, lam in modes]
+    for (mode, lam), (label, rep) in zip(modes, reports.items()):
+        fc, v = aggregate_ensemble(stack, panel.valid, mode, lam)
+        assert_reports_match(run_backtest(fc, v, panel, min_universe=10),
+                             rep)
+
+
+def test_mode_sweep_shares_one_compiled_core():
+    """Compile-once contract: after the first dispatch, same-shape calls
+    with different λs, costs, quantiles or long/short flags pay ZERO new
+    traces (those knobs are traced arguments, not trace constants)."""
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    panel = random_panel(seed=8)
+    rng = np.random.default_rng(8)
+    stack = rng.standard_normal((3,) + panel.targets.shape).astype(np.float32)
+    modes = [("mean", 1.0), ("mean_minus_std", 1.0), ("mean_minus_std", 2.0)]
+    run_scoring_pipeline(stack, panel.valid, panel, modes=modes,
+                         min_universe=10)
+    snap = REUSE_COUNTERS.snapshot()
+    run_scoring_pipeline(stack, panel.valid, panel,
+                         modes=[("mean", 1.0), ("mean_minus_std", 0.25),
+                                ("mean_minus_std", 4.0)],
+                         min_universe=10, quantile=0.2, costs_bps=10.0,
+                         long_short=True)
+    assert REUSE_COUNTERS.delta(snap)["jit_traces"] == 0
+
+
+def test_engine_dispatch_knob(monkeypatch):
+    """LFM_JAX_BACKTEST routes the serving path: fused by default, the
+    numpy reference when 0."""
+    assert jax_backtest_enabled()
+    assert resolve_backtest() is run_backtest_jax
+    monkeypatch.setenv("LFM_JAX_BACKTEST", "0")
+    assert not jax_backtest_enabled()
+    assert resolve_backtest() is run_backtest
+    monkeypatch.delenv("LFM_JAX_BACKTEST")
+    assert resolve_backtest() is run_backtest_jax
+
+
+def test_walkforward_score_stitched_fused_matches_numpy(monkeypatch):
+    """run_walkforward's end-of-sweep scoring hook: the fused path and
+    the LFM_JAX_BACKTEST=0 numpy path produce the same digests."""
+    from lfm_quant_tpu.train.walkforward import score_stitched
+
+    panel = random_panel(seed=11)
+    rng = np.random.default_rng(11)
+    stack = rng.standard_normal((2,) + panel.targets.shape).astype(np.float32)
+    modes = ["mean", ("mean_minus_std", 0.5)]
+    fused = score_stitched(stack, panel.valid, panel, modes,
+                           min_universe=10)
+    monkeypatch.setenv("LFM_JAX_BACKTEST", "0")
+    host = score_stitched(stack, panel.valid, panel, modes, min_universe=10)
+    assert list(fused) == list(host) == ["mean", "mean_minus_std@0.5"]
+    for label in fused:
+        for k, v in fused[label].items():
+            if isinstance(v, float):
+                assert v == pytest.approx(host[label][k], rel=1e-3,
+                                          abs=2e-4), (label, k)

@@ -187,6 +187,71 @@ def test_reuse_path_matches_serial_path(panel, tmp_path, monkeypatch):
     np.testing.assert_array_equal(fc_r, fc_s)
 
 
+def test_multi_step_donates_state(panel, tmp_path):
+    """Donation guard for the fast ``-m reuse`` lane: the multi-step
+    wrapper must CONSUME its input TrainState (XLA aliases the donated
+    params/opt_state buffers into the outputs — the HBM double-buffer
+    this PR removed), and donation must not break the zero-retrace
+    contract: a second same-shape dispatch pays no new traces. An
+    un-donated fallback (donation quietly dropped by a refactor) fails
+    the is_deleted assertion; a donation-induced retrace fails the
+    counter one."""
+    import jax
+
+    splits = PanelSplits.by_date(panel, 198001, 198201)
+    t = Trainer(_cfg(tmp_path), splits)
+    state = t.init_state()
+    b = t.train_sampler.stacked_epoch(0)
+    fi, ti, w = t._batch_args(b, train=True, steps=True)
+    st, _ = t._jit_multi_step(state, t.dev, fi, ti, w)
+    jax.block_until_ready(st)
+    donated = [leaf.is_deleted()
+               for leaf in jax.tree.leaves((state.params, state.opt_state))]
+    assert all(donated), "multi-step input state was NOT donated"
+    snap = REUSE_COUNTERS.snapshot()
+    st2, _ = t._jit_multi_step(st, t.dev, fi, ti, w)
+    jax.block_until_ready(st2)
+    assert REUSE_COUNTERS.delta(snap)["jit_traces"] == 0
+
+
+def test_donation_kill_switch(panel, tmp_path, monkeypatch):
+    """LFM_DONATE=0 restores the double-buffered path (input state stays
+    alive), and the donation flag is part of the program key — a bundle
+    built with donation on is never served to a donation-off trainer."""
+    import jax
+
+    splits = PanelSplits.by_date(panel, 198001, 198201)
+    t_on = Trainer(_cfg(tmp_path / "on"), splits)
+    monkeypatch.setenv("LFM_DONATE", "0")
+    t_off = Trainer(_cfg(tmp_path / "off"), splits)
+    assert t_off.program_key != t_on.program_key
+    state = t_off.init_state()
+    b = t_off.train_sampler.stacked_epoch(0)
+    fi, ti, w = t_off._batch_args(b, train=True, steps=True)
+    st, _ = t_off._jit_multi_step(state, t_off.dev, fi, ti, w)
+    jax.block_until_ready(st)
+    assert not any(leaf.is_deleted()
+                   for leaf in jax.tree.leaves(state.params))
+
+
+def test_ensemble_multi_step_donates_state(panel, tmp_path):
+    """Same donation guard through the seed-vmapped ensemble wrapper —
+    the stacked state is where the double-buffer actually hurt (64 seeds
+    × params + both Adam moments)."""
+    import jax
+
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+    splits = PanelSplits.by_date(panel, 198001, 198201)
+    e = EnsembleTrainer(_cfg(tmp_path, n_seeds=2), splits)
+    state = e.init_state()
+    fi, ti, w = e._stacked_epoch(0)
+    st, _ = e._jit_multi_step(state, e.dev, fi, ti, w)
+    jax.block_until_ready(st)
+    assert all(leaf.is_deleted()
+               for leaf in jax.tree.leaves((state.params, state.opt_state)))
+
+
 def test_program_cache_lru_bound(monkeypatch):
     """The program cache is LRU-bounded (LFM_PROGRAM_CACHE_SIZE): a
     long-lived process sweeping many geometries must not pin every

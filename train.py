@@ -63,13 +63,38 @@ def main(argv=None) -> int:
                          "expanding window). Fixed-length folds keep "
                          "identical batch shapes, so the cross-fold reuse "
                          "layer compiles the whole sweep exactly once")
+    ap.add_argument("--wf-score", metavar="MODES", default=None,
+                    help="grade the stitched out-of-sample panel at the "
+                         "end of the sweep: comma-separated aggregation "
+                         "modes, each optionally MODE@LAMBDA (e.g. "
+                         "'mean,mean_minus_std@0.5,mean_minus_std@2'). "
+                         "Runs through the fused device-resident scoring "
+                         "path (LFM_JAX_BACKTEST, default on; numpy "
+                         "engine as fallback); reports land in "
+                         "summary.json under 'backtest'")
     args = ap.parse_args(argv)
     if args.walk_forward is None and (
             args.wf_start is not None or args.wf_folds is not None
             or args.wf_val_months != 24 or args.wf_warm_start
-            or args.wf_train_months is not None):
+            or args.wf_train_months is not None or args.wf_score is not None):
         ap.error("--wf-start/--wf-val-months/--wf-folds/--wf-warm-start/"
-                 "--wf-train-months need --walk-forward STEP_MONTHS")
+                 "--wf-train-months/--wf-score need --walk-forward "
+                 "STEP_MONTHS")
+    wf_score_modes = None
+    if args.wf_score:
+        # Validate HERE, not at end-of-sweep: a typo'd mode must fail at
+        # parse time, not after hours of fold training (normalize_modes
+        # is numpy-only — no jax init cost at argparse time).
+        from lfm_quant_tpu.backtest.engine import normalize_modes
+
+        wf_score_modes = []
+        try:
+            for tok in args.wf_score.split(","):
+                mode, _, lam = tok.strip().partition("@")
+                wf_score_modes.append((mode, float(lam)) if lam else mode)
+            normalize_modes(wf_score_modes)
+        except ValueError as e:
+            ap.error(f"--wf-score: {e}")
 
     # Import late so --help works instantly without initializing JAX.
     import dataclasses
@@ -90,6 +115,16 @@ def main(argv=None) -> int:
         cfg = dataclasses.replace(cfg, n_seeds=args.n_seeds)
     if args.out is not None:
         cfg = dataclasses.replace(cfg, out_dir=args.out)
+    if wf_score_modes is not None:
+        names = [m[0] if isinstance(m, tuple) else m for m in wf_score_modes]
+        if cfg.n_seeds < 2 and "mean_minus_std" in names:
+            ap.error("--wf-score mean_minus_std needs stacked forecasts "
+                     "(n_seeds > 1); a single-seed sweep stitches one "
+                     "model's panel, whose seed-axis std is identically 0")
+        if "mean_minus_total_std" in names and not cfg.is_heteroscedastic:
+            ap.error("--wf-score mean_minus_total_std needs stitched "
+                     "aleatoric variances — train the walk-forward with a "
+                     "heteroscedastic config (loss='nll')")
     if args.scale is not None:
         d = cfg.data
         cfg = dataclasses.replace(cfg, data=dataclasses.replace(
@@ -128,7 +163,8 @@ def main(argv=None) -> int:
                 val_months=args.wf_val_months, n_folds=args.wf_folds,
                 out_dir=wf_dir, echo=args.echo, resume=args.resume,
                 warm_start=args.wf_warm_start,
-                train_months=args.wf_train_months)
+                train_months=args.wf_train_months,
+                score_modes=wf_score_modes)
             summary["run_dir"] = wf_dir
         elif cfg.n_seeds > 1:
             from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
