@@ -15,7 +15,19 @@ import orbax.checkpoint as ocp
 
 
 class CheckpointManager:
-    """Thin wrapper over ocp.CheckpointManager for train-state pytrees."""
+    """Thin wrapper over ocp.CheckpointManager for train-state pytrees.
+
+    ASYNC BY DEFAULT: Orbax's manager runs saves on a background thread
+    (``enable_async_checkpointing`` defaults True on the pinned
+    version), so :meth:`save` with ``wait=False`` returns as soon as the
+    write is staged — the epoch pipeline (train/pipeline.py) hands it a
+    HOST-FETCHED state copy precisely so the background writer never
+    races buffer donation on device. Durability contract: commits are
+    atomic (tmp-dir + rename), ``latest_step`` only ever reports
+    committed steps, and a second ``save`` on the same manager while one
+    is in flight serializes internally — overlapping the *best* and
+    *latest* lines needs two managers, which is what FitHarness holds.
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self._mgr = ocp.CheckpointManager(
@@ -26,6 +38,9 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
+        """Stage a save of ``state`` at ``step``; ``wait=True`` blocks
+        until it is durably committed (the synchronous reference path —
+        ``LFM_ASYNC_CKPT=0`` semantics)."""
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mgr.wait_until_finished()

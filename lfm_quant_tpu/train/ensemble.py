@@ -359,28 +359,42 @@ class EnsembleTrainer:
             arrays = shard_batch(self.mesh, arrays, with_seed_axis=True)
         return arrays
 
-    def _stacked_epoch(self, epoch: int) -> Tuple:
-        """One whole epoch for all seeds: [K, S, D, Bf] index stacks
-        (K = steps, truncated to the shortest member epoch)."""
+    def _build_epoch(self, epoch: Optional[int]) -> Tuple[Tuple, float]:
+        """One whole epoch for all seeds — [K, S, D, Bf] index stacks
+        (K = steps, truncated to the shortest member epoch) — plus the
+        epoch's firm-month count, computed from the HOST stacks before
+        the device transfer so throughput accounting never forces a
+        device→host sync. Thread-safe for explicit epochs (the async
+        pipeline's prefetch thread builds and stages here)."""
         per_seed = [s.stacked_epoch(epoch) for s in self.samplers]
         k = min(b.firm_idx.shape[0] for b in per_seed)
         fi = np.stack([b.firm_idx[:k] for b in per_seed], axis=1)
         ti = np.stack([b.time_idx[:k] for b in per_seed], axis=1)
         w = np.stack([b.weight[:k] for b in per_seed], axis=1)
+        fm = float(w.sum()) * self.window
         arrays = (jnp.asarray(fi), jnp.asarray(ti), jnp.asarray(w))
         if self.mesh is not None:
             arrays = shard_batch(self.mesh, arrays, with_seed_axis=True,
                                  steps_axis=True)
-        return arrays
+        return arrays, fm
+
+    def _stacked_epoch(self, epoch: Optional[int] = None) -> Tuple:
+        """Back-compat surface (tests/bench): the stacked device arrays
+        of :meth:`_build_epoch` without the firm-month count."""
+        return self._build_epoch(epoch)[0]
 
     # ---- training ----------------------------------------------------
 
     def evaluate(self, params_stacked) -> Dict[str, Any]:
-        """Per-member and ensemble-mean val IC in ONE vmapped dispatch."""
+        """Per-member and ensemble-mean val IC in ONE vmapped dispatch
+        (and one device→host sync, counted by the pipeline observability
+        counters)."""
+        from lfm_quant_tpu.utils.profiling import timed_device_get
+
         b = self.val_sampler.stacked_cross_sections()
         fi, ti, w = self.inner._batch_args(b)
         _, ic, _ = self._jit_forward(params_stacked, self.dev, fi, ti, w)
-        ics = np.asarray(ic)  # [S, M]
+        ics = timed_device_get(ic)  # [S, M]
         counts = b.weight.sum(axis=1)  # [M]
         per_seed = (ics * counts).sum(axis=1) / counts.sum()
         return {"ic_per_seed": per_seed, "ic_mean": float(per_seed.mean()),
@@ -389,9 +403,18 @@ class EnsembleTrainer:
     def fit(self, resume: bool = False, init_params=None) -> Dict[str, Any]:
         """Lock-step ensemble training with crash resume (ckpt/latest every
         epoch) and best-model tracking (ckpt/best) — see Trainer.fit.
+        Runs through the same async epoch-pipeline driver
+        (train/pipeline.py, ``LFM_ASYNC`` / ``LFM_ASYNC_CKPT``): one
+        fused train+eval dispatch chain and ONE device_get per epoch,
+        next epoch's [K, S, D, Bf] stacks staged on a background thread
+        and dispatched before this epoch's metrics sync, checkpoints
+        saved asynchronously from a host-fetched copy of the stacked
+        state.
 
         ``init_params``: seed-stacked [S, ...] params to start from (the
         walk-forward warm start); optimizer state restarts fresh."""
+        from lfm_quant_tpu.train import pipeline
+
         cfg = self.cfg
         if cfg.optim.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {cfg.optim.epochs}")
@@ -414,31 +437,42 @@ class EnsembleTrainer:
                 state = self._commit_state(TrainState(**restored))
         logger = MetricsLogger(self.run_dir, echo=self.echo)
         timer = StepTimer()
-
         history = []
-        while (epoch := harness.next_epoch()) is not None:
-            timer.start()
-            # Whole epoch × all seeds in one compiled dispatch.
-            fi, ti, w = self._stacked_epoch(epoch)
-            state, ms = self._jit_multi_step(state, self.dev, fi, ti, w)
-            fm = float(np.asarray(w).sum()) * self.window
-            mean_loss = float(ms["loss"].mean())  # sync point
-            timer.stop(firm_months=fm)
 
-            val = self.evaluate(state.params)
-            step_now = int(np.asarray(state.step)[0])
+        # Epoch-invariant val-sweep prep, hoisted off the critical path.
+        vb = self.val_sampler.stacked_cross_sections()
+        vargs = self.inner._batch_args(vb)
+        counts = vb.weight.sum(axis=1)  # [M]
+
+        def build(epoch):
+            return self._build_epoch(epoch)
+
+        def dispatch(state, arrays):
+            # Whole epoch × all seeds + the vmapped val sweep chained on
+            # one stream; scalars fetched by the driver in one call.
+            state, ms = self._jit_multi_step(state, self.dev, *arrays)
+            _, ic, _ = self._jit_forward(state.params, self.dev, *vargs)
+            return state, {"loss": ms["loss"].mean(), "ic": ic,
+                           "step": state.step[0]}
+
+        def finish(epoch, host, fm):
+            per_seed = (host["ic"] * counts).sum(axis=1) / counts.sum()
+            val_ic = float(per_seed.mean())
+            step = int(host["step"])
             rec = logger.log(
-                step_now,
+                step,
                 epoch=epoch,
-                train_loss=mean_loss,
-                val_ic=val["ic_mean"],
-                val_ic_std=val["ic_std"],
+                train_loss=float(host["loss"]),
+                val_ic=val_ic,
+                val_ic_std=float(per_seed.std()),
                 firm_months_per_sec=timer.throughput(),
             )
             history.append(rec)
-            if harness.end_epoch(epoch, step_now, state._asdict(),
-                                 val["ic_mean"]):
-                break
+            return step, val_ic
+
+        state, overrun = pipeline.run_fit_epochs(
+            harness, state, build=build, dispatch=dispatch, finish=finish,
+            timer=timer, checkpointing=self.run_dir is not None)
 
         best = harness.finalize(state._asdict())
         if best is not None:
@@ -451,6 +485,7 @@ class EnsembleTrainer:
             "epochs_run": harness.last_epoch + 1,
             "n_seeds": self.n_seeds,
             "firm_months_per_sec": timer.throughput(),
+            "lookahead_overrun": overrun is not None,
             "history": history,
         }
 

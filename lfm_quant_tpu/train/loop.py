@@ -45,7 +45,7 @@ from lfm_quant_tpu.ops import (
 )
 from lfm_quant_tpu.train.checkpoint import CheckpointManager
 from lfm_quant_tpu.utils.logging import MetricsLogger
-from lfm_quant_tpu.utils.profiling import StepTimer
+from lfm_quant_tpu.utils.profiling import StepTimer, timed_device_get
 
 
 class TrainState(NamedTuple):
@@ -168,9 +168,22 @@ class FitHarness:
 
     def resume(self, abstract_state_dict) -> Optional[Dict[str, Any]]:
         """Restore the latest checkpoint + loop counters. Returns the
-        restored state dict, or None when nothing is checkpointed. A
-        missing/corrupt sidecar (crash inside the persist window) degrades
-        to counters derived from the checkpoint step instead of failing."""
+        restored state dict, or None when nothing is checkpointed.
+
+        The sidecar is only trusted where the DURABLE evidence backs it:
+        a crash with async saves in flight can leave it ahead of either
+        checkpoint line (it is written when the saves START), and a
+        crash between a commit and the sidecar write leaves it behind.
+        A sidecar out of step with the LATEST line in either direction
+        falls back to step-derived counters (trusting a BEHIND sidecar
+        would retrain the committed epoch on top of its own result); a
+        sidecar claiming a best epoch the BEST line never committed
+        falls back to the committed best (its IC recovered from the
+        metrics stream via :meth:`_recover_best`) — the phantom best's
+        params are unrecoverable, so pinning its IC would make
+        ``finalize`` restore a checkpoint that never matched the
+        reported best. A missing/corrupt sidecar degrades the same way
+        instead of failing."""
         if not self.latest_mgr:
             return None
         step = self.latest_mgr.latest_step()
@@ -179,15 +192,63 @@ class FitHarness:
         restored = restore_state_dict(self.latest_mgr, abstract_state_dict)
         try:
             prog = load_progress(self.run_dir)
+            if (prog["epoch"] + 1) * self.steps_per_epoch != int(step):
+                # Ahead: async save never committed. BEHIND: crash between
+                # a commit and the sidecar write — trusting the sidecar
+                # would retrain the committed epoch ON TOP of its own
+                # result and skew the step↔epoch arithmetic for good.
+                raise KeyError("progress sidecar out of step with "
+                               "latest line")
             self.start_epoch = prog["epoch"] + 1
+            claimed = ((prog["best_epoch"] + 1) * self.steps_per_epoch
+                       if prog["best_epoch"] >= 0 else None)
+            durable = self.best_mgr.latest_step() if self.best_mgr else None
+            if claimed is not None and (durable is None
+                                        or durable < claimed):
+                raise KeyError("progress sidecar ahead of best line")
             self.best_ic = prog["best_ic"]
             self.best_epoch = prog["best_epoch"]
             self.bad_epochs = prog["bad_epochs"]
-        except (FileNotFoundError, json.JSONDecodeError, KeyError):
+        except (FileNotFoundError, json.JSONDecodeError, KeyError,
+                TypeError):
             self.start_epoch = int(step) // self.steps_per_epoch
-            self.best_ic, self.best_epoch, self.bad_epochs = -np.inf, -1, 0
+            self._recover_best()
         self._epoch = self.start_epoch - 1
         return restored
+
+    def _recover_best(self) -> None:
+        """Rebuild best-line counters from DURABLE evidence only: the
+        committed best checkpoint's step plus its logged val IC in
+        metrics.jsonl (written before any save starts, so it always
+        covers a committed epoch). Epochs whose best save never
+        committed count as non-improving — their params are gone, so
+        this is the best restorable contract (the resumed run may
+        re-improve and re-save; it will never report a best_ic no
+        checkpoint can back). A committed best whose IC is NOT
+        recoverable (metrics stream missing/corrupt) keeps its epoch
+        with best_ic=-inf: ``finalize`` can still restore it when no
+        retrained epoch beats it, which strictly dominates forgetting
+        the checkpoint exists. Fresh counters only when no best ever
+        committed."""
+        self.best_ic, self.best_epoch, self.bad_epochs = -np.inf, -1, 0
+        durable = self.best_mgr.latest_step() if self.best_mgr else None
+        if durable is None:
+            return
+        best_epoch = int(durable) // self.steps_per_epoch - 1
+        best_ic = -np.inf
+        try:
+            with open(os.path.join(self.run_dir, "metrics.jsonl")) as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # a line truncated by the crash itself
+                    if rec.get("epoch") == best_epoch and "val_ic" in rec:
+                        best_ic = float(rec["val_ic"])
+        except (OSError, ValueError):
+            pass
+        self.best_ic, self.best_epoch = best_ic, best_epoch
+        self.bad_epochs = max(0, self.start_epoch - 1 - best_epoch)
 
     def next_epoch(self) -> Optional[int]:
         """The next epoch to train, or None when done — including a resumed
@@ -208,15 +269,38 @@ class FitHarness:
     def end_epoch(self, epoch: int, step: int, state_dict, val_ic: float
                   ) -> bool:
         """Record an epoch: update best, persist both checkpoint lines and
-        the progress sidecar. Returns True when early stopping triggers."""
+        the progress sidecar. Returns True when early stopping triggers.
+
+        Both saves START asynchronously so the best and latest lines
+        always overlap each other; with ``LFM_ASYNC_CKPT`` on (default)
+        neither is waited for here — the caller hands in a host-fetched
+        state copy (train/pipeline.py) and the writes drain behind the
+        next epoch's compute, flushed only at :meth:`finalize`. With it
+        off, one barrier per line at the end of this method restores the
+        synchronous durability contract (still faster than the old
+        serial save→wait→save→wait). A crashed async save loses at most
+        the in-flight epoch: Orbax commits atomically and
+        :meth:`resume` reconciles a sidecar that ran ahead."""
+        from lfm_quant_tpu.train.reuse import async_ckpt_enabled
+
+        saved_best = False
         if val_ic > self.best_ic:
             self.best_ic, self.best_epoch, self.bad_epochs = val_ic, epoch, 0
             if self.best_mgr:
-                self.best_mgr.save(step, state_dict, wait=True)
+                self.best_mgr.save(step, state_dict, wait=False)
+                saved_best = True
         else:
             self.bad_epochs += 1
         if self.latest_mgr:
-            self.latest_mgr.save(step, state_dict, wait=True)
+            self.latest_mgr.save(step, state_dict, wait=False)
+            if not async_ckpt_enabled():
+                # Sync reference path: both lines durable BEFORE the
+                # sidecar records them (the pre-pipeline ordering) — a
+                # crash can then never leave the sidecar claiming a
+                # best/latest that no committed checkpoint backs.
+                if saved_best:
+                    self.best_mgr.wait()
+                self.latest_mgr.wait()
             save_progress(self.run_dir, epoch=epoch,
                           best_ic=float(self.best_ic),
                           best_epoch=self.best_epoch,
@@ -224,8 +308,13 @@ class FitHarness:
         return self.bad_epochs >= self.patience
 
     def finalize(self, abstract_state_dict) -> Optional[Dict[str, Any]]:
-        """Restore the best state (if any) and close the managers."""
+        """Flush in-flight async saves, restore the best state (if any)
+        and close the managers. The wait precedes the restore: the best
+        checkpoint being read may still be committing."""
         best = None
+        if self.latest_mgr:
+            self.best_mgr.wait()
+            self.latest_mgr.wait()
         if (self.best_mgr and self.best_epoch >= 0
                 and self.best_mgr.latest_step() is not None):
             best = restore_state_dict(self.best_mgr, abstract_state_dict)
@@ -973,13 +1062,18 @@ class Trainer:
     def evaluate(self, state_params, sampler=None) -> Dict[str, float]:
         """Validation sweep in ONE dispatch: all eval months stacked into a
         single [M, bf] batch (rows = months, so per-month IC comes out of
-        the same [D, Bf] code path; month-sharded over the data mesh)."""
+        the same [D, Bf] code path; month-sharded over the data mesh) —
+        and ONE device→host sync: the per-month ICs and the mse scalar
+        come back in a single ``jax.device_get`` (the old
+        ``np.asarray(ic)`` + ``float(mse)`` pair paid dispatch-path
+        latency twice)."""
         sampler = sampler or self.val_sampler
         b = sampler.stacked_cross_sections()
         _, ic, mse = self._forward_eval(state_params, b)
         counts = b.weight.sum(axis=1)
+        ic, mse = timed_device_get((ic, mse))
         return {
-            "ic": float(np.average(np.asarray(ic), weights=counts)),
+            "ic": float(np.average(ic, weights=counts)),
             "mse": float(mse),
             "n_months": int(counts.size),
         }
@@ -994,7 +1088,23 @@ class Trainer:
         ``init_params``: start from these params instead of a fresh init —
         the walk-forward warm start (optimizer state and step counter are
         fresh either way; a crash resume takes precedence since the latest
-        checkpoint already embodies the warm start)."""
+        checkpoint already embodies the warm start).
+
+        The epoch loop runs through the async pipeline driver
+        (train/pipeline.py, ``LFM_ASYNC`` / ``LFM_ASYNC_CKPT`` knobs):
+        each epoch is ONE multi-step dispatch with the validation sweep
+        chained on the same stream, all scalars fetched in one
+        ``jax.device_get``, the next epoch's batches prefetched and
+        dispatched before this epoch's metrics sync, and checkpoints
+        saved asynchronously from a host-fetched copy. The lock-step
+        reference path (``LFM_ASYNC=0``) is numerically identical —
+        including after an early stop that strands a speculative
+        lookahead epoch: the driver rolls the state back to the last
+        RECORDED epoch's snapshot, so predict/warm-start consumers see
+        the same state in either mode (and with a run dir, finalize
+        restores the best checkpoint on top, exactly as before)."""
+        from lfm_quant_tpu.train import pipeline
+
         cfg = self.cfg
         if cfg.optim.epochs < 1:
             raise ValueError(f"epochs must be >= 1, got {cfg.optim.epochs}")
@@ -1010,34 +1120,68 @@ class Trainer:
                 state = self._commit_state(TrainState(**restored))
         logger = MetricsLogger(self.run_dir, echo=self.echo)
         timer = StepTimer()
-
         history = []
-        while (epoch := harness.next_epoch()) is not None:
-            timer.start()
-            # Whole epoch in one compiled dispatch (lax.scan over steps).
-            b = self.train_sampler.stacked_epoch(epoch)
-            fi, ti, w = self._batch_args(b, train=True, steps=True)
-            state, ms = self._jit_multi_step(state, self.dev, fi, ti, w)
-            fm = float(b.weight.sum()) * self.window
-            # float() forces the device round-trip — the real sync point.
-            epoch_loss = float(ms["loss"].mean())
-            epoch_gnorm = float(ms["grad_norm"].mean())
-            timer.stop(firm_months=fm)
 
-            val = self.evaluate(state.params)
+        # Hoisted epoch-invariant val-sweep prep: the stacked eval batch
+        # (and, under a mesh, its padded device placement) is identical
+        # every epoch — building it per epoch was pure host overhead on
+        # the critical path.
+        vb = self.val_sampler.stacked_cross_sections()
+        counts = vb.weight.sum(axis=1)
+        if self._eval_sharded:
+            vargs = self._eval_batch_args(vb)
+            n_val = vb.weight.shape[0]
+
+            def val_dispatch(params):
+                _, ic, mse = self._jit_fwd_det(params, self.dev, *vargs)
+                return ic[:n_val], mse
+        else:
+            vargs = (jnp.asarray(vb.firm_idx), jnp.asarray(vb.time_idx),
+                     jnp.asarray(vb.weight))
+
+            def val_dispatch(params):
+                _, ic, mse = self._jit_forward(params, self.dev, *vargs)
+                return ic, mse
+
+        def build(epoch):
+            # Whole epoch as one [K, D, Bf] index stack; firm-months are
+            # known on the host before any device work.
+            b = self.train_sampler.stacked_epoch(epoch)
+            fm = float(b.weight.sum()) * self.window
+            return self._batch_args(b, train=True, steps=True), fm
+
+        def dispatch(state, args):
+            # Train epoch + chained validation sweep on one stream; no
+            # host round-trip here — the driver fetches ``vals`` in a
+            # single device_get when the epoch settles.
+            state, ms = self._jit_multi_step(state, self.dev, *args)
+            ic, mse = val_dispatch(state.params)
+            # step is COPIED out of the state: the lookahead dispatch
+            # donates every state leaf, and a fetched scalar must not
+            # alias a donated buffer.
+            return state, {"loss": ms["loss"].mean(),
+                           "grad_norm": ms["grad_norm"].mean(),
+                           "ic": ic, "mse": mse,
+                           "step": jnp.copy(state.step)}
+
+        def finish(epoch, host, fm):
+            val_ic = float(np.average(host["ic"], weights=counts))
+            step = int(host["step"])
             rec = logger.log(
-                int(state.step),
+                step,
                 epoch=epoch,
-                train_loss=epoch_loss,
-                grad_norm=epoch_gnorm,
-                val_ic=val["ic"],
-                val_mse=val["mse"],
+                train_loss=float(host["loss"]),
+                grad_norm=float(host["grad_norm"]),
+                val_ic=val_ic,
+                val_mse=float(host["mse"]),
                 firm_months_per_sec=timer.throughput(),
             )
             history.append(rec)
-            if harness.end_epoch(epoch, int(state.step), state._asdict(),
-                                 val["ic"]):
-                break
+            return step, val_ic
+
+        state, overrun = pipeline.run_fit_epochs(
+            harness, state, build=build, dispatch=dispatch, finish=finish,
+            timer=timer, checkpointing=self.run_dir is not None)
 
         # Restore best state for downstream prediction/backtest.
         best = harness.finalize(state._asdict())
@@ -1049,8 +1193,9 @@ class Trainer:
             "best_val_ic": harness.best_ic,
             "best_epoch": harness.best_epoch,
             "epochs_run": harness.last_epoch + 1,
-            "steps": int(state.step),
+            "steps": (harness.last_epoch + 1) * harness.steps_per_epoch,
             "firm_months_per_sec": timer.throughput(),
+            "lookahead_overrun": overrun is not None,
             "history": history,
         }
 

@@ -1,0 +1,265 @@
+"""Async epoch pipeline: overlap host work and I/O with device compute.
+
+PR 1 made compilation and panel transfer one-time costs and PR 2 fused
+the scoring path, but the training loop stayed lock-step: between two
+epoch-long dispatches the device idled while the host sampled the next
+epoch's indices, synced metrics, and blocked on two serial Orbax saves.
+This module hides those per-epoch fixed costs behind device compute
+(PAPERS.md: "Large-Batch Training for LSTM and Beyond"; "Accelerating
+recurrent neural network training using sequence bucketing and
+multi-GPU data parallelization"):
+
+* **Fused train+eval epoch** — the validation sweep is chained onto the
+  same dispatch stream as the multi-step train program, and ALL of an
+  epoch's scalars (loss, grad-norm, per-month val IC, mse, step) come
+  back in ONE ``jax.device_get`` instead of a scatter of ``float()`` /
+  ``np.asarray`` syncs.
+* **One-epoch lookahead** (``LFM_ASYNC``, default on) — epoch e+1's
+  stacked index batches are built and H2D-staged on a background thread
+  while epoch e computes, and epoch e+1 is DISPATCHED before epoch e's
+  metrics are synced. The early-stopping decision therefore runs one
+  epoch behind: when it fires, the already-dispatched epoch is
+  discarded (never recorded, never checkpointed) — at most one wasted
+  epoch of compute, and the device never idles between epochs.
+* **Async checkpointing** (``LFM_ASYNC_CKPT``, default on) — both
+  checkpoint lines are saved in the background from a HOST-FETCHED copy
+  of the state; the loop waits only at ``finalize``/resume boundaries.
+
+Donation safety: the multi-step wrappers donate their input TrainState
+(train/reuse.py), so once epoch e+1 is dispatched, epoch e's output
+buffers are gone. The pipeline therefore queues a device-side copy of
+the state BEFORE the donating dispatch (a data dependency XLA orders
+correctly); the copy is what checkpointing reads. With donation off
+(``LFM_DONATE=0``) the copy is skipped — the buffers stay alive.
+
+Numerics: pipelining reorders host/dispatch work only. Every traced
+program, every input, and every recorded metric is identical to the
+lock-step loop — ``LFM_ASYNC=0/1`` produce the same epoch history, best
+epoch, early-stop epoch and restored best params (tests/test_pipeline.py
+pins this), which is why the knobs are not program-cache keys.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS, timed_device_get
+
+
+class EpochPrefetcher:
+    """One-epoch-lookahead batch builder: runs ``build(epoch)`` — host
+    sampling (native or python engine) PLUS the ``jnp.asarray`` /
+    ``shard_batch`` H2D staging — on a daemon thread so it overlaps the
+    in-flight epoch's device compute. One outstanding epoch at a time
+    (serializing the staging keeps H2D bandwidth off the critical path);
+    ``get`` for a different epoch than the one staged falls back to an
+    inline build, so resumes and non-contiguous schedules stay correct.
+    Safe because ``DateBatchSampler`` calls with an EXPLICIT epoch are
+    pure reads (deterministic in (seed, epoch), no shared counters)."""
+
+    def __init__(self, build: Callable[[int], Any]):
+        self._build = build
+        self._epoch: Optional[int] = None
+        self._thread: Optional[threading.Thread] = None
+        self._out: Optional[Dict[str, Any]] = None
+
+    def start(self, epoch: int) -> None:
+        if self._thread is not None and self._epoch == epoch:
+            return
+        self.cancel()
+        out: Dict[str, Any] = {}
+
+        def run():
+            try:
+                out["result"] = self._build(epoch)
+            except BaseException as e:  # noqa: BLE001 — re-raised in get()
+                out["error"] = e
+
+        self._epoch, self._out = epoch, out
+        self._thread = threading.Thread(
+            target=run, name=f"lfm-epoch-prefetch-{epoch}", daemon=True)
+        self._thread.start()
+
+    def get(self, epoch: int) -> Any:
+        """The staged batches for ``epoch`` (joins the builder thread),
+        or an inline build on a miss."""
+        if self._thread is None or self._epoch != epoch:
+            self.cancel()
+            return self._build(epoch)
+        self._thread.join()
+        out = self._out
+        self._thread, self._epoch, self._out = None, None, None
+        if "error" in out:
+            raise out["error"]
+        return out["result"]
+
+    def cancel(self) -> None:
+        """Join-and-discard any staged build. A build is not
+        interruptible, but it is bounded by one epoch of host sampling —
+        joining here keeps the builder from racing a ``rebind()`` that
+        mutates the sampler/panel bindings after ``fit`` returns."""
+        if self._thread is not None:
+            self._thread.join()
+        self._thread, self._epoch, self._out = None, None, None
+
+
+class _InFlight(NamedTuple):
+    """A dispatched-but-unsynced epoch: the device scalars to fetch, the
+    state snapshot checkpointing will read, and the host-known
+    firm-month count for throughput accounting."""
+
+    epoch: int
+    vals: Dict[str, Any]
+    snap: Any
+    fm: float
+
+
+def _snapshot(state, checkpointing: bool, async_mode: bool):
+    """The state object ``end_epoch`` may checkpoint for this epoch —
+    and, in async mode, the ROLLBACK target when early stopping strands
+    a speculative epoch (the driver returns the last RECORDED epoch's
+    state, keeping the final state pipeline-invariant even without a
+    best checkpoint to restore).
+
+    Lookahead + donation is the hazardous combination: the NEXT dispatch
+    consumes the state's buffers, so a device-side copy is queued first
+    (ordered before the donating dispatch by data dependency). Without
+    donation the live state reference suffices. Async mode snapshots
+    even when the run doesn't checkpoint — the rollback needs it; the
+    copy overlaps device compute and at most one extra state copy is
+    live at a time. Lock-step mode has no speculative epochs, so ``None``
+    when not checkpointing — zero overhead."""
+    if not async_mode:
+        return state if checkpointing else None
+    if reuse.donation_enabled():
+        return jax.tree.map(jnp.copy, state)
+    return state
+
+
+def _all_ready(vals: Dict[str, Any]) -> bool:
+    """Non-blocking completion probe: True when every device value of an
+    epoch's fetch set has materialized (the eval outputs are queued
+    LAST, so all-ready ⇒ the epoch's dispatch chain has drained).
+    Conservatively False on runtimes without ``Array.is_ready``."""
+    try:
+        return all(leaf.is_ready() for leaf in jax.tree.leaves(vals))
+    except AttributeError:
+        return False
+
+
+def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
+                   checkpointing: bool) -> Tuple[Any, Optional[int]]:
+    """Drive a fit's epoch loop — lock-step or pipelined (``LFM_ASYNC``).
+
+    Callback contract (shared by Trainer and EnsembleTrainer):
+
+    * ``build(epoch) -> (batches, firm_months)`` — host sampling + H2D
+      staging; MUST be thread-safe for explicit epochs (runs on the
+      prefetch thread in async mode).
+    * ``dispatch(state, batches) -> (state, vals)`` — queue the
+      multi-step train program AND the chained validation sweep; ``vals``
+      is a dict of DEVICE arrays (must include ``"step"``) that one
+      ``jax.device_get`` fetches per epoch. Must not sync.
+    * ``finish(epoch, host_vals, firm_months) -> (step, val_ic)`` —
+      host-side: log the epoch record, append history, return the int
+      step and scalar val IC for the harness.
+
+    Returns ``(final_state, overrun_epoch)`` — ``overrun_epoch`` is the
+    epoch that was speculatively dispatched when early stopping fired
+    (its results were discarded; None when the stop was clean). The
+    harness's counters (``last_epoch``, ``bad_epochs``) always reflect
+    RECORDED epochs only, so ``epochs_run`` is pipeline-invariant.
+    """
+    async_mode = reuse.async_enabled()
+    prefetch = EpochPrefetcher(build) if async_mode else None
+    drained_at: Optional[float] = None
+
+    def settle(p: _InFlight, drained: bool) -> bool:
+        """Sync one epoch's scalars (ONE device_get, snapshot included
+        when async checkpointing needs the host copy), record it, and
+        run the harness bookkeeping. Returns True on early stop."""
+        nonlocal drained_at
+        snap_dict = (p.snap._asdict()
+                     if checkpointing and p.snap is not None else None)
+        if snap_dict is not None and reuse.async_ckpt_enabled():
+            host_vals, snap_dict = timed_device_get((p.vals, snap_dict))
+        else:
+            host_vals = timed_device_get(p.vals)
+        if drained:
+            drained_at = time.perf_counter()
+        timer.stop(firm_months=p.fm)
+        timer.start()
+        step, val_ic = finish(p.epoch, host_vals, p.fm)
+        return harness.end_epoch(p.epoch, step, snap_dict, val_ic)
+
+    # Async-mode idle probe: (timestamp, was-the-in-flight-epoch-done)
+    # sampled at the END of each loop iteration. If the in-flight epoch
+    # had already drained by then, every second until the next dispatch
+    # is measured device idle — a LOWER bound (an epoch finishing
+    # mid-gap contributes zero), so a reported non-zero async idle is
+    # real, and zero means "not observed", not "proven absent".
+    probe: Optional[Tuple[float, bool]] = None
+
+    timer.start()
+    epoch = harness.next_epoch()
+    inflight: Optional[_InFlight] = None
+    overrun: Optional[int] = None
+    try:
+        while epoch is not None:
+            batches, fm = (prefetch.get(epoch) if prefetch is not None
+                           else build(epoch))
+            if drained_at is not None:
+                REUSE_COUNTERS.device_idle_s += (
+                    time.perf_counter() - drained_at)
+                drained_at = None
+            if probe is not None and probe[1]:
+                REUSE_COUNTERS.device_idle_s += (
+                    time.perf_counter() - probe[0])
+            probe = None
+            state, vals = dispatch(state, batches)
+            snap = _snapshot(state, checkpointing, async_mode)
+            if not async_mode:
+                if settle(_InFlight(epoch, vals, snap, fm), drained=True):
+                    break
+                epoch = harness.next_epoch()
+                continue
+            # Lookahead: stage e+1's batches and (below) dispatch e+1
+            # BEFORE syncing e's metrics. The stop decision lags one
+            # epoch, so the harness's epoch counter only advances when
+            # the PREVIOUS epoch settles as "continue" — an epoch that
+            # turns out to be the overrun is never recorded anywhere.
+            cand = epoch + 1 if epoch + 1 < harness.epochs else None
+            if cand is not None:
+                prefetch.start(cand)
+            if inflight is not None:
+                if settle(inflight, drained=False):
+                    # Early stop with `epoch` speculatively in flight:
+                    # roll the returned state back to the last RECORDED
+                    # epoch's snapshot so downstream consumers (predict,
+                    # walk-forward warm starts) see the same state the
+                    # lock-step loop would have ended on.
+                    overrun = epoch
+                    if inflight.snap is not None:
+                        state = inflight.snap
+                    inflight = None
+                    break
+                stepped = harness.next_epoch()
+                if stepped != epoch:  # pragma: no cover — invariant
+                    raise RuntimeError(
+                        f"pipeline epoch skew: dispatched {epoch}, "
+                        f"harness advanced to {stepped}")
+            inflight = _InFlight(epoch, vals, snap, fm)
+            probe = (time.perf_counter(), _all_ready(vals))
+            epoch = cand
+        if inflight is not None:
+            settle(inflight, drained=True)
+    finally:
+        if prefetch is not None:
+            prefetch.cancel()
+    return state, overrun

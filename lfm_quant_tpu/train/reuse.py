@@ -86,6 +86,33 @@ def donation_enabled() -> bool:
     return os.environ.get("LFM_DONATE", "1") != "0"
 
 
+def async_enabled() -> bool:
+    """Epoch-pipeline kill switch: ``LFM_ASYNC=0`` forces the lock-step
+    training loop (build → dispatch → sync → checkpoint serially per
+    epoch) — the parity reference for the one-epoch-lookahead pipeline
+    (train/pipeline.py). Default ON, mirroring the ``LFM_JAX_BACKTEST``
+    / ``LFM_DONATE`` convention: the fast path is the default and the
+    knob is the escape hatch / A/B switch. Pipelining changes dispatch
+    ORDER only, never a traced program or its numerics, so it is
+    deliberately NOT part of the program cache key."""
+    return os.environ.get("LFM_ASYNC", "1") != "0"
+
+
+def async_ckpt_enabled() -> bool:
+    """Async-checkpoint kill switch: ``LFM_ASYNC_CKPT=0`` makes
+    ``FitHarness.end_epoch`` flush both checkpoint lines before
+    returning (the two saves still overlap each other — one barrier per
+    line at the end). With it ON (default), Orbax saves run entirely in
+    the background from a host-fetched copy of the state and the loop
+    only waits at ``finalize``/resume boundaries. Durability contract:
+    Orbax commits are atomic (tmp-dir rename), so a crash mid-save can
+    lose AT MOST the in-flight epoch's checkpoint — ``FitHarness.resume``
+    reconciles a progress sidecar that ran ahead of the last committed
+    step. Orthogonal to ``LFM_ASYNC`` (all four combinations are legal
+    and parity-tested)."""
+    return os.environ.get("LFM_ASYNC_CKPT", "1") != "0"
+
+
 def multi_step_donate_argnums() -> Tuple[int, ...]:
     """``donate_argnums`` for the jitted MULTI-step wrappers: the
     TrainState argument (position 0) is donated so XLA aliases the

@@ -389,8 +389,13 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
             # Fold-level compile/transfer cost: 0 jit_traces and 0
             # panel_transfers on every fold after the first is the reuse
             # layer's contract on a same-shape schedule (tests/test_reuse
-            # and bench.py walkforward_reuse assert it here).
-            "reuse": REUSE_COUNTERS.delta(reuse_snap),
+            # and bench.py walkforward_reuse assert it here). The same
+            # delta carries the epoch pipeline's sync-point accounting
+            # (host_syncs / host_sync_s / device_idle_s — one blocking
+            # fetch per epoch, near-zero idle with LFM_ASYNC on), so
+            # every fold record prices its host-sync overhead too.
+            "reuse": {k: (round(v, 4) if isinstance(v, float) else v)
+                      for k, v in REUSE_COUNTERS.delta(reuse_snap).items()},
         })
         if out_dir:
             os.makedirs(out_dir, exist_ok=True)

@@ -97,6 +97,23 @@ class ReuseCounters:
       fresh jit wrappers (which then trace lazily on first dispatch).
     * ``panel_cache_hits`` — device-panel residency cache hits (a fold
       bound an already-resident panel instead of re-transferring).
+    * ``host_syncs`` / ``host_sync_s`` — blocking device→host fetches on
+      the training path (:func:`timed_device_get`) and the wall seconds
+      spent blocked in them. The async epoch pipeline's contract is ONE
+      such fetch per epoch (loss + grad-norm + per-month val IC + mse +
+      step in a single ``jax.device_get``) instead of a scatter of
+      ``float()``/``np.asarray`` syncs.
+    * ``device_idle_s`` — host-observed device-idle seconds. Lock-step
+      mode: the gap between draining the dispatch pipeline (an epoch's
+      scalars fetched with nothing else in flight) and the next
+      dispatch — the serial host window (sampling, eval sync,
+      checkpoint writes) the one-epoch-lookahead pipeline
+      (train/pipeline.py, ``LFM_ASYNC``) exists to hide. Async mode: a
+      LOWER bound from non-blocking readiness probes — the in-flight
+      epoch observed already-complete at the end of a loop iteration
+      accrues idle until the next dispatch (an epoch finishing mid-gap
+      contributes zero). A proxy either way, not a hardware counter:
+      non-zero means real measured idle; zero means none observed.
     """
 
     jit_traces: int = 0
@@ -105,6 +122,9 @@ class ReuseCounters:
     program_cache_hits: int = 0
     program_cache_misses: int = 0
     panel_cache_hits: int = 0
+    host_syncs: int = 0
+    host_sync_s: float = 0.0
+    device_idle_s: float = 0.0
 
     def snapshot(self) -> Dict[str, int]:
         return dataclasses.asdict(self)
@@ -123,6 +143,20 @@ class ReuseCounters:
 #: delta pairs) are the supported read pattern — absolute values mix all
 #: trainers ever built in the process.
 REUSE_COUNTERS = ReuseCounters()
+
+
+def timed_device_get(tree):
+    """``jax.device_get`` with host-sync accounting: bumps
+    ``REUSE_COUNTERS.host_syncs`` and adds the blocked wall time to
+    ``host_sync_s``. The training loop routes EVERY blocking device→host
+    fetch through here, which is what makes "one sync per epoch" a
+    measured property (fold records in train/walkforward.py, the
+    ``epoch_pipeline`` bench metric) instead of a claim."""
+    t0 = time.perf_counter()
+    out = jax.device_get(tree)
+    REUSE_COUNTERS.host_syncs += 1
+    REUSE_COUNTERS.host_sync_s += time.perf_counter() - t0
+    return out
 
 
 def count_traces(name: str, fn: Callable) -> Callable:

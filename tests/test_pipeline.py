@@ -1,0 +1,270 @@
+"""Async epoch pipeline (train/pipeline.py): parity + crash semantics.
+
+The pipeline's contract is that it reorders work, never results:
+``LFM_ASYNC=1`` must produce the same epoch history, best-val-IC epoch,
+early-stop epoch and restored best params as the lock-step reference
+(``LFM_ASYNC=0``), the speculative lookahead epoch must never leak into
+history or checkpoints, and a crash with an async checkpoint in flight
+must resume from the last DURABLE step. All tests carry the
+``pipeline`` marker — the fast CI guard (``pytest -m pipeline``)
+against a refactor that quietly breaks the overlap's determinism.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.train.loop import FitHarness, Trainer
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+pytestmark = pytest.mark.pipeline
+
+#: History fields that must match bit-for-bit across pipeline modes
+#: (timing fields — ts, firm_months_per_sec — legitimately differ).
+_DET_FIELDS = ("epoch", "train_loss", "grad_norm", "val_ic", "val_mse")
+
+
+def _cfg(tmp, epochs=4, patience=99, lr=1e-3, n_seeds=1):
+    return RunConfig(
+        name="pipe",
+        data=DataConfig(n_firms=100, n_months=200, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=lr, epochs=epochs, warmup_steps=5, loss="mse",
+                          early_stop_patience=patience),
+        seed=0,
+        n_seeds=n_seeds,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=100, n_months=200, n_features=5, seed=5)
+
+
+@pytest.fixture(scope="module")
+def splits(panel):
+    return PanelSplits.by_date(panel, 198001, 198201)
+
+
+def _fit(tmp, splits, monkeypatch, async_on, name, **cfg_kw):
+    monkeypatch.setenv("LFM_ASYNC", "1" if async_on else "0")
+    monkeypatch.setenv("LFM_ASYNC_CKPT", "1" if async_on else "0")
+    cfg = _cfg(tmp, **cfg_kw)
+    run_dir = str(tmp / name)
+    ctor = Trainer
+    if cfg.n_seeds > 1:
+        from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+        ctor = EnsembleTrainer
+    trainer = ctor(cfg, splits, run_dir=run_dir)
+    summary = trainer.fit()
+    return trainer, summary, run_dir
+
+
+def _det(history):
+    return [tuple((k, r[k]) for k in _DET_FIELDS if k in r) for r in history]
+
+
+def _params_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def test_async_sync_parity(splits, tmp_path, monkeypatch):
+    """The acceptance contract: identical epoch history (losses, ICs,
+    mses bit-for-bit), best epoch, epochs run, and restored best params
+    between LFM_ASYNC=0 and LFM_ASYNC=1."""
+    t0, s0, _ = _fit(tmp_path, splits, monkeypatch, False, "sync")
+    t1, s1, _ = _fit(tmp_path, splits, monkeypatch, True, "async")
+    assert _det(s0["history"]) == _det(s1["history"])
+    assert s0["best_epoch"] == s1["best_epoch"]
+    assert s0["epochs_run"] == s1["epochs_run"]
+    assert s0["best_val_ic"] == s1["best_val_ic"]
+    # Both ended on the best-checkpoint restore — same params.
+    assert _params_equal(t0.state.params, t1.state.params)
+
+
+def test_async_sync_parity_under_early_stop(splits, tmp_path, monkeypatch):
+    """lr=0 freezes val IC after epoch 0, so patience=1 stops the run
+    deterministically: with lookahead, epoch 2 is already dispatched
+    when the decision lands — it must be discarded, leaving history,
+    epochs_run, the early-stop epoch and the checkpoint lines identical
+    to the lock-step run (at most one WASTED epoch, never a recorded
+    one)."""
+    kw = dict(epochs=8, patience=1, lr=0.0)
+    t0, s0, d0 = _fit(tmp_path, splits, monkeypatch, False, "es_sync", **kw)
+    t1, s1, d1 = _fit(tmp_path, splits, monkeypatch, True, "es_async", **kw)
+    assert s0["epochs_run"] < 8, "geometry must actually early-stop"
+    assert s0["epochs_run"] == s1["epochs_run"]
+    assert _det(s0["history"]) == _det(s1["history"])
+    assert s1["lookahead_overrun"], "async stop should strand one dispatch"
+    assert not s0["lookahead_overrun"]
+    assert _params_equal(t0.state.params, t1.state.params)
+    # The overrun epoch never reached either checkpoint line or the
+    # metrics stream.
+    spe = t1.train_sampler.batches_per_epoch()
+    from lfm_quant_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(os.path.join(d1, "ckpt", "latest"))
+    assert mgr.latest_step() == s1["epochs_run"] * spe
+    mgr.close()
+    lines = [json.loads(l)
+             for l in open(os.path.join(d1, "metrics.jsonl"))]
+    assert [l["epoch"] for l in lines] == list(range(s1["epochs_run"]))
+
+
+def test_overrun_rollback_without_run_dir(splits, tmp_path, monkeypatch):
+    """Early stop with a stranded lookahead epoch and NO run dir (no
+    best checkpoint to restore): the driver must roll the final state
+    back to the last recorded epoch's snapshot, so downstream consumers
+    (predict, walk-forward warm starts) see identical params in both
+    pipeline modes."""
+    params = {}
+    for async_on in (False, True):
+        monkeypatch.setenv("LFM_ASYNC", "1" if async_on else "0")
+        monkeypatch.setenv("LFM_ASYNC_CKPT", "1" if async_on else "0")
+        # Real lr (not the frozen lr=0 shortcut): the stranded epoch
+        # genuinely trains, so an un-rolled-back state WOULD differ.
+        trainer = Trainer(_cfg(tmp_path, epochs=8, patience=1, lr=1e-3),
+                          splits, run_dir=None)
+        s = trainer.fit()
+        assert s["epochs_run"] < 8
+        assert s["lookahead_overrun"] == async_on
+        params[async_on] = trainer.state.params
+    assert _params_equal(params[False], params[True])
+
+
+def test_ensemble_async_sync_parity(splits, tmp_path, monkeypatch):
+    """Same contract through the seed-vmapped ensemble loop (stacked
+    state snapshot + one device_get of the [S, M] IC panel)."""
+    kw = dict(n_seeds=2, epochs=3)
+    t0, s0, _ = _fit(tmp_path, splits, monkeypatch, False, "ens_sync", **kw)
+    t1, s1, _ = _fit(tmp_path, splits, monkeypatch, True, "ens_async", **kw)
+    assert _det(s0["history"]) == _det(s1["history"])
+    assert s0["best_epoch"] == s1["best_epoch"]
+    assert _params_equal(t0.state.params, t1.state.params)
+
+
+def test_resume_reconciles_inflight_async_checkpoint(splits, tmp_path,
+                                                     monkeypatch):
+    """Crash with an async save in flight: the progress sidecar (written
+    when the save STARTS) can run ahead of the last COMMITTED step.
+    Resume must trust the durable checkpoint — deriving its counters
+    from the checkpoint step — and retrain the lost epochs instead of
+    skipping them."""
+    t1, s1, run_dir = _fit(tmp_path, splits, monkeypatch, True, "crash",
+                           epochs=2)
+    spe = t1.train_sampler.batches_per_epoch()
+    # Forge the in-flight-crash artifact: sidecar claims epoch 3 done,
+    # but the latest durable checkpoint is epoch 1's.
+    with open(os.path.join(run_dir, "fit_progress.json"), "w") as fh:
+        json.dump({"epoch": 3, "best_ic": 99.0, "best_epoch": 3,
+                   "bad_epochs": 0}, fh)
+    t2 = Trainer(_cfg(tmp_path, epochs=4), splits, run_dir=run_dir)
+    s2 = t2.fit(resume=True)
+    # Counters came from the checkpoint (step 2·spe → epoch 2), not the
+    # bogus sidecar (which would have resumed at epoch 4 with a fake
+    # best_ic pinning best forever).
+    assert [r["epoch"] for r in s2["history"]] == [2, 3]
+    assert s2["best_val_ic"] != 99.0
+    assert s2["steps"] == 4 * spe
+    # Best tracking was RECOVERED from the durable best line (not reset
+    # to -inf): the resumed best can only improve on the committed one,
+    # so a bad retrained epoch can never overwrite a better durable best.
+    assert s2["best_val_ic"] >= s1["best_val_ic"]
+
+
+def test_resume_discards_phantom_best_claim(splits, tmp_path, monkeypatch):
+    """Crash with the BEST save in flight but the latest save committed:
+    the sidecar claims a best epoch the best line never durably holds.
+    Resume must fall back to the committed best (IC recovered from
+    metrics.jsonl) — pinning the phantom IC would make finalize restore
+    a checkpoint that never matched the reported best."""
+    t1, s1, run_dir = _fit(tmp_path, splits, monkeypatch, True, "phantom",
+                           epochs=2, lr=0.0)
+    assert s1["best_epoch"] == 0  # lr=0: only epoch 0 ever improves
+    real_best_ic = s1["history"][0]["val_ic"]
+    # Forge the crash artifact: sidecar consistent with the LATEST line
+    # (epoch 1 done) but claiming an epoch-1 best whose save never
+    # committed (the durable best is still epoch 0's).
+    with open(os.path.join(run_dir, "fit_progress.json"), "w") as fh:
+        json.dump({"epoch": 1, "best_ic": 99.0, "best_epoch": 1,
+                   "bad_epochs": 0}, fh)
+    t2 = Trainer(_cfg(tmp_path, epochs=4, lr=0.0), splits, run_dir=run_dir)
+    s2 = t2.fit(resume=True)
+    assert [r["epoch"] for r in s2["history"]] == [2, 3]
+    assert s2["best_epoch"] == 0
+    assert s2["best_val_ic"] == real_best_ic
+    # finalize restored the checkpoint the counters describe.
+    assert _params_equal(t1.state.params, t2.state.params)
+
+
+def test_resume_rejects_stale_sidecar_behind_checkpoint(splits, tmp_path,
+                                                        monkeypatch):
+    """The inverse crash window: saves committed, sidecar write lost.
+    A sidecar BEHIND the latest line must also be rejected — trusting
+    it would retrain the committed epoch on top of its own result."""
+    t1, s1, run_dir = _fit(tmp_path, splits, monkeypatch, True, "stale",
+                           epochs=2)
+    spe = t1.train_sampler.batches_per_epoch()
+    with open(os.path.join(run_dir, "fit_progress.json"), "w") as fh:
+        json.dump({"epoch": 0, "best_ic": s1["history"][0]["val_ic"],
+                   "best_epoch": 0, "bad_epochs": 0}, fh)
+    t2 = Trainer(_cfg(tmp_path, epochs=4), splits, run_dir=run_dir)
+    s2 = t2.fit(resume=True)
+    # Epoch 1 (committed) was NOT retrained; training resumed at 2.
+    assert [r["epoch"] for r in s2["history"]] == [2, 3]
+    assert s2["steps"] == 4 * spe
+
+
+def test_sidecar_consistent_resume_unchanged(splits, tmp_path, monkeypatch):
+    """The reconciliation guard must NOT fire on a healthy sidecar: a
+    clean async-ckpt run resumes exactly where it stopped, with the
+    sidecar's best/bad counters intact."""
+    _fit(tmp_path, splits, monkeypatch, True, "clean", epochs=2)
+    run_dir = str(tmp_path / "clean")
+    t = Trainer(_cfg(tmp_path, epochs=4), splits, run_dir=run_dir)
+    harness = FitHarness(run_dir, 4, 99, t.train_sampler.batches_per_epoch())
+    restored = harness.resume(t.init_state()._asdict())
+    assert restored is not None
+    prog = json.load(open(os.path.join(run_dir, "fit_progress.json")))
+    assert harness.start_epoch == prog["epoch"] + 1 == 2
+    assert harness.best_ic == prog["best_ic"]
+
+
+def test_one_host_sync_per_epoch(splits, tmp_path, monkeypatch):
+    """The fused-fetch contract, measured: a fit's training loop pays
+    exactly ONE counted blocking device→host fetch per recorded epoch
+    (loss + grad-norm + val ICs + mse + step in a single device_get) —
+    in BOTH pipeline modes."""
+    for async_on, name in ((False, "sync1"), (True, "async1")):
+        snap = REUSE_COUNTERS.snapshot()
+        _, s, _ = _fit(tmp_path, splits, monkeypatch, async_on, name,
+                       epochs=3)
+        d = REUSE_COUNTERS.delta(snap)
+        assert d["host_syncs"] == s["epochs_run"], (name, d)
+
+
+def test_async_knobs_are_independent(splits, tmp_path, monkeypatch):
+    """The two kill switches compose: lookahead with synchronous saves
+    (LFM_ASYNC=1, LFM_ASYNC_CKPT=0) and lock-step with async saves
+    (0, 1) both preserve the reference results — the four-way knob
+    matrix shares one numerical identity."""
+    t_ref, s_ref, _ = _fit(tmp_path, splits, monkeypatch, False, "ref")
+    for async_loop, async_ckpt in ((True, False), (False, True)):
+        monkeypatch.setenv("LFM_ASYNC", "1" if async_loop else "0")
+        monkeypatch.setenv("LFM_ASYNC_CKPT", "1" if async_ckpt else "0")
+        name = f"mix_{int(async_loop)}{int(async_ckpt)}"
+        trainer = Trainer(_cfg(tmp_path), splits,
+                          run_dir=str(tmp_path / name))
+        s = trainer.fit()
+        assert _det(s["history"]) == _det(s_ref["history"]), name
+        assert _params_equal(t_ref.state.params, trainer.state.params), name

@@ -104,6 +104,29 @@ def test_ensemble_second_fold_zero_traces_zero_transfers(panel, tmp_path):
     assert r1["panel_transfers"] == 0, r1
 
 
+def test_async_pipeline_zero_traces_on_warm_folds(panel, tmp_path,
+                                                  monkeypatch):
+    """Pipeline × reuse guard: the prefetch/double-buffer machinery
+    (train/pipeline.py — background H2D staging, chained eval dispatch,
+    device-side checkpoint snapshots) must add ZERO jit traces and ZERO
+    panel H2D transfers on warm folds, with the knobs pinned ON
+    explicitly so a flipped default can never silently shrink this
+    lane's coverage. One blocking host fetch per epoch is part of the
+    same contract (host_syncs == epochs trained in the fold)."""
+    monkeypatch.setenv("LFM_ASYNC", "1")
+    monkeypatch.setenv("LFM_ASYNC_CKPT", "1")
+    _, _, summary = _run_wf(_cfg(tmp_path), panel, tmp_path,
+                            train_months=72)
+    r0, r1 = [r["reuse"] for r in summary["folds"]]
+    assert r0["jit_traces"] > 0 and r0["panel_transfers"] == 1
+    assert r1["jit_traces"] == 0, r1
+    assert r1["panel_transfers"] == 0, r1
+    # Sync-point observability rides the same per-fold delta: each
+    # fold's epochs paid exactly one counted device→host fetch each.
+    for rec, r in zip(summary["folds"], (r0, r1)):
+        assert r["host_syncs"] == rec["epochs_run"], r
+
+
 def test_changed_model_config_misses_cache(panel, tmp_path):
     """Invalidation: a changed model config is a different program key —
     fresh compile (cache miss + new traces), never a stale executable."""
