@@ -58,6 +58,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from lfm_quant_tpu.backtest import aggregate_ensemble, resolve_backtest
+    from lfm_quant_tpu.utils import telemetry
 
     # Engine dispatch: the fused device-resident backtest
     # (backtest/jax_engine.py — all months in one jitted dispatch) by
@@ -65,77 +66,95 @@ def main(argv=None) -> int:
     # is unavailable. Same report either way (parity-suite contract).
     run_backtest = resolve_backtest()
 
+    # Telemetry scope over the scoring run: manifest + spans land in the
+    # run dir being graded (the stitched file's directory for
+    # --forecast-npz), so `scripts/trace_report.py <dir>` covers the
+    # backtest pass too. LFM_TELEMETRY=0 makes this a no-op.
+    tele_dir = args.run_dir
     if args.forecast_npz:
-        import numpy as np
+        tele_dir = (args.forecast_npz if os.path.isdir(args.forecast_npz)
+                    else os.path.dirname(args.forecast_npz) or ".")
+    with telemetry.run_scope(tele_dir, extra={
+            "entry": "backtest",
+            "cli": {"mode": args.mode, "quantile": args.quantile,
+                    "long_short": args.long_short,
+                    "costs_bps": args.costs_bps,
+                    "mc_samples": args.mc_samples}}):
+        if args.forecast_npz:
+            import numpy as np
 
-        from lfm_quant_tpu.config import RunConfig
-        from lfm_quant_tpu.train.loop import resolve_panel
+            from lfm_quant_tpu.config import RunConfig
+            from lfm_quant_tpu.train.loop import resolve_panel
 
-        if args.mc_samples > 0:
-            ap.error("--mc-samples needs a live model; a forecast file is "
-                     "already sampled/stitched")
-        if args.split is not None:
-            ap.error("--split does not apply to --forecast-npz: the "
-                     "simulated months are fixed by the stitched file")
-        path = args.forecast_npz
-        if os.path.isdir(path):
-            path = os.path.join(path, "walkforward.npz")
-        with open(os.path.join(os.path.dirname(path), "config.json")) as fh:
-            cfg = RunConfig.from_json(fh.read())
-        data = np.load(path)
-        forecast, fc_valid = data["forecast"], data["valid"]
-        panel = resolve_panel(cfg.data)
-        if args.mode == "mean_minus_total_std":
-            if "variance" not in data:
-                ap.error("--mode mean_minus_total_std needs stitched "
-                         "aleatoric variances; this file has none (train "
-                         "the walk-forward with a heteroscedastic config "
-                         "— loss='nll')")
-            avar = data["variance"]
-            if forecast.ndim == 2:  # single heteroscedastic model
-                forecast, avar = forecast[None], avar[None]
-            forecast, fc_valid = aggregate_ensemble(
-                forecast, fc_valid, args.mode, args.risk_lambda,
-                aleatoric_var=avar)
-        elif forecast.ndim == 3:  # stacked walk-forward ensemble
-            forecast, fc_valid = aggregate_ensemble(
-                forecast, fc_valid, args.mode, args.risk_lambda)
-        elif args.mode != "mean":
-            ap.error(f"--mode {args.mode} needs stacked forecasts; this "
-                     "file holds a single model's (already-aggregated) "
-                     "walk-forward forecasts")
-    else:
-        from lfm_quant_tpu.train.forecast import (is_ensemble_run_dir,
-                                                  load_forecaster,
-                                                  run_forecast)
+            if args.mc_samples > 0:
+                ap.error("--mc-samples needs a live model; a forecast file "
+                         "is already sampled/stitched")
+            if args.split is not None:
+                ap.error("--split does not apply to --forecast-npz: the "
+                         "simulated months are fixed by the stitched file")
+            path = args.forecast_npz
+            if os.path.isdir(path):
+                path = os.path.join(path, "walkforward.npz")
+            with open(os.path.join(os.path.dirname(path),
+                                   "config.json")) as fh:
+                cfg = RunConfig.from_json(fh.read())
+            data = np.load(path)
+            forecast, fc_valid = data["forecast"], data["valid"]
+            panel = resolve_panel(cfg.data)
+            if args.mode == "mean_minus_total_std":
+                if "variance" not in data:
+                    ap.error("--mode mean_minus_total_std needs stitched "
+                             "aleatoric variances; this file has none "
+                             "(train the walk-forward with a "
+                             "heteroscedastic config — loss='nll')")
+                avar = data["variance"]
+                if forecast.ndim == 2:  # single heteroscedastic model
+                    forecast, avar = forecast[None], avar[None]
+                forecast, fc_valid = aggregate_ensemble(
+                    forecast, fc_valid, args.mode, args.risk_lambda,
+                    aleatoric_var=avar)
+            elif forecast.ndim == 3:  # stacked walk-forward ensemble
+                forecast, fc_valid = aggregate_ensemble(
+                    forecast, fc_valid, args.mode, args.risk_lambda)
+            elif args.mode != "mean":
+                ap.error(f"--mode {args.mode} needs stacked forecasts; "
+                         "this file holds a single model's (already-"
+                         "aggregated) walk-forward forecasts")
+        else:
+            from lfm_quant_tpu.train.forecast import (is_ensemble_run_dir,
+                                                      load_forecaster,
+                                                      run_forecast)
 
-        if is_ensemble_run_dir(args.run_dir) and args.mc_samples > 0:
-            # Validate BEFORE load_forecaster restores every seed
-            # checkpoint (minutes on a real ensemble run dir).
-            ap.error("--mc-samples applies to single-model run dirs "
-                     "only; this is a seed ensemble — its uncertainty "
-                     "comes from the seeds (use --mode mean_minus_std "
-                     "directly)")
-        model, splits, is_ensemble = load_forecaster(args.run_dir)
-        forecast, fc_valid = run_forecast(
-            model, is_ensemble, mode=args.mode,
-            risk_lambda=args.risk_lambda, mc_samples=args.mc_samples,
-            error=ap.error, split=args.split or "test")
-        panel = splits.panel
+            if is_ensemble_run_dir(args.run_dir) and args.mc_samples > 0:
+                # Validate BEFORE load_forecaster restores every seed
+                # checkpoint (minutes on a real ensemble run dir).
+                ap.error("--mc-samples applies to single-model run dirs "
+                         "only; this is a seed ensemble — its uncertainty "
+                         "comes from the seeds (use --mode mean_minus_std "
+                         "directly)")
+            model, splits, is_ensemble = load_forecaster(args.run_dir)
+            with telemetry.span("predict", cat="predict"):
+                forecast, fc_valid = run_forecast(
+                    model, is_ensemble, mode=args.mode,
+                    risk_lambda=args.risk_lambda, mc_samples=args.mc_samples,
+                    error=ap.error, split=args.split or "test")
+            panel = splits.panel
 
-    report = run_backtest(
-        forecast, fc_valid, panel,
-        quantile=args.quantile, long_short=args.long_short,
-        costs_bps=args.costs_bps,
-    )
-    print(report.summary())
-    if args.yearly:
-        for y, rec in sorted(report.yearly().items()):
-            print(f"  {y}: ret {rec['ret']:+8.2%}  bench {rec['bench']:+8.2%}"
-                  f"  IC {rec['mean_ic']:+.3f}  ({rec['n_months']} mo)")
-    if args.json_out:
-        with open(args.json_out, "w") as fh:
-            fh.write(report.to_json())
+        with telemetry.span("score", cat="score"):
+            report = run_backtest(
+                forecast, fc_valid, panel,
+                quantile=args.quantile, long_short=args.long_short,
+                costs_bps=args.costs_bps,
+            )
+        print(report.summary())
+        if args.yearly:
+            for y, rec in sorted(report.yearly().items()):
+                print(f"  {y}: ret {rec['ret']:+8.2%}  bench "
+                      f"{rec['bench']:+8.2%}  IC {rec['mean_ic']:+.3f}  "
+                      f"({rec['n_months']} mo)")
+        if args.json_out:
+            with open(args.json_out, "w") as fh:
+                fh.write(report.to_json())
     return 0
 
 

@@ -634,6 +634,7 @@ def bench_epoch_pipeline() -> None:
                                       RunConfig)
     from lfm_quant_tpu.data import PanelSplits, synthetic_panel
     from lfm_quant_tpu.train import Trainer
+    from lfm_quant_tpu.utils import telemetry
     from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
 
     n_epochs = max(2, int(os.environ.get("LFM_BENCH_PIPE_EPOCHS", "8")))
@@ -682,9 +683,20 @@ def bench_epoch_pipeline() -> None:
                 else:
                     os.environ[k] = v
 
+    # Telemetry-derived compile accounting: the program ledger
+    # (utils/telemetry.py, fed by train/reuse.py ledger_jit) records
+    # every program build's compile wall seconds. Snapshot around the
+    # warmup pass so the row prices the one-time compile tax the
+    # measured reps then amortize — the idle fractions below come from
+    # the same telemetry counter registry (device_idle_s), so the row
+    # is self-describing without a bench re-run (trace_report's rollup
+    # uses identical formulas).
+    ledger0 = telemetry.program_ledger_totals()
     one(True)  # warmup: traces + XLA compiles (shared by both modes)
+    ledger1 = telemetry.program_ledger_totals()
     async_reps = sorted(one(True) for _ in range(reps))
     sync_reps = sorted(one(False) for _ in range(reps))
+    ledger2 = telemetry.program_ledger_totals()
     a_med = async_reps[len(async_reps) // 2]
     s_med = sync_reps[len(sync_reps) // 2]
     extras = {
@@ -693,6 +705,17 @@ def bench_epoch_pipeline() -> None:
         "speedup": round(a_med[0] / max(s_med[0], 1e-9), 2),
         "idle_frac_async": round(a_med[1], 3),
         "idle_frac_sync": round(s_med[1], 3),
+        # null (not a measured-looking 0.0) when LFM_TELEMETRY=0: the
+        # ledger records nothing then, and a zero row would read as a
+        # genuinely warm compile cache against the baselines.
+        "compile_s_warmup": (round(
+            ledger1["compile_s"] - ledger0["compile_s"], 3)
+            if telemetry.enabled() else None),
+        "compile_s_timed_reps": (round(
+            ledger2["compile_s"] - ledger1["compile_s"], 3)
+            if telemetry.enabled() else None),
+        "program_builds": (int(ledger2["builds"] - ledger0["builds"])
+                           if telemetry.enabled() else None),
         "n_epochs": n_epochs,
         "n_reps": reps,
         "rep_values": [round(r[0], 1) for r in async_reps],

@@ -71,6 +71,7 @@ from lfm_quant_tpu.backtest.engine import (
 )
 from lfm_quant_tpu.data.panel import Panel
 from lfm_quant_tpu.ops.metrics import hard_ranks, pearson_ic
+from lfm_quant_tpu.utils import telemetry
 
 # Mode name → which uncertainty tensor the λ-penalty scales (static
 # program structure; λ itself is a traced argument, so a λ grid reuses
@@ -150,7 +151,7 @@ def _core_for(n_buckets: int):
     bucket count and array shapes — quantile (k-table), min_universe,
     costs and long/short arrive as traced arguments, so a mode/λ/cost
     sweep pays ZERO recompiles after the first dispatch."""
-    from lfm_quant_tpu.utils.profiling import count_traces
+    from lfm_quant_tpu.train.reuse import ledger_jit
 
     def month_stats(f, u, r, rank_tgt, rank_r, tv_any, n, k):
         """One month's cross-section × one mode's scores → portfolio/IC/
@@ -245,7 +246,7 @@ def _core_for(n_buckets: int):
                 "ret_ic": st["ret_ic"], "turn": turn, "turn_has": turn_has,
                 "bmean": st["bmean"], "bhas": st["bhas"]}
 
-    return jax.jit(count_traces(f"backtest_core_b{n_buckets}", core))
+    return ledger_jit(f"backtest_core_b{n_buckets}", core)
 
 
 def _dispatch_core(scores, u, panel: Panel, quantile: float,
@@ -253,15 +254,18 @@ def _dispatch_core(scores, u, panel: Panel, quantile: float,
                    profile_buckets: int) -> dict:
     """Stage inputs and run the jitted core; returns the host-fetched
     per-month output dict (one small D2H for everything)."""
-    dev = _device_score_panel(panel)
-    out = _core_for(profile_buckets)(
-        scores, u, dev["returns"], dev["targets"], dev["target_valid"],
-        _k_table(panel.n_firms, quantile),
-        jnp.asarray(min_universe, jnp.int32),
-        jnp.asarray(costs_bps, jnp.float32),
-        jnp.asarray(bool(long_short)),
-    )
-    return jax.device_get(out)
+    with telemetry.span("score_dispatch", cat="score",
+                        modes=int(scores.shape[0]),
+                        months=int(scores.shape[1])):
+        dev = _device_score_panel(panel)
+        out = _core_for(profile_buckets)(
+            scores, u, dev["returns"], dev["targets"], dev["target_valid"],
+            _k_table(panel.n_firms, quantile),
+            jnp.asarray(min_universe, jnp.int32),
+            jnp.asarray(costs_bps, jnp.float32),
+            jnp.asarray(bool(long_short)),
+        )
+        return jax.device_get(out)
 
 
 def _report_for_mode(out: dict, g: int, dates: np.ndarray, *,
@@ -378,8 +382,9 @@ def aggregate_scores_device(
         # mean/std-only sweeps don't re-trace when avar is absent.
         avar = jnp.zeros((1,) + forecasts.shape[1:], forecasts.dtype)
     lams = jnp.asarray([lam for _, lam in specs], jnp.float32)
-    scores = _aggregate_modes(forecasts, jnp.asarray(valid), lams, avar,
-                              kinds)
+    with telemetry.span("aggregate", cat="score", modes=len(specs)):
+        scores = _aggregate_modes(forecasts, jnp.asarray(valid), lams, avar,
+                                  kinds)
     return scores, valid, specs
 
 

@@ -13,6 +13,8 @@ from typing import Any, Optional
 
 import orbax.checkpoint as ocp
 
+from lfm_quant_tpu.utils import telemetry
+
 
 class CheckpointManager:
     """Thin wrapper over ocp.CheckpointManager for train-state pytrees.
@@ -30,6 +32,7 @@ class CheckpointManager:
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3):
+        self._line = os.path.basename(directory)  # "best" | "latest"
         self._mgr = ocp.CheckpointManager(
             os.path.abspath(directory),
             options=ocp.CheckpointManagerOptions(
@@ -41,9 +44,11 @@ class CheckpointManager:
         """Stage a save of ``state`` at ``step``; ``wait=True`` blocks
         until it is durably committed (the synchronous reference path —
         ``LFM_ASYNC_CKPT=0`` semantics)."""
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
-        if wait:
-            self._mgr.wait_until_finished()
+        with telemetry.span("ckpt_save", cat="ckpt", line=self._line,
+                            step=step, wait=wait):
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+            if wait:
+                self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -60,7 +65,8 @@ class CheckpointManager:
         )
 
     def wait(self):
-        self._mgr.wait_until_finished()
+        with telemetry.span("ckpt_wait", cat="ckpt", line=self._line):
+            self._mgr.wait_until_finished()
 
     def close(self):
         self._mgr.close()

@@ -54,6 +54,7 @@ from lfm_quant_tpu.train.loop import (
     Trainer,
     restore_state_dict,
 )
+from lfm_quant_tpu.utils import telemetry
 from lfm_quant_tpu.utils.logging import MetricsLogger
 from lfm_quant_tpu.utils.profiling import StepTimer
 
@@ -69,7 +70,7 @@ class EnsemblePrograms:
     executables."""
 
     def __init__(self, inner, mesh, n_seeds: int, seed_block: int):
-        from lfm_quant_tpu.utils.profiling import count_traces
+        from lfm_quant_tpu.train.reuse import ledger_jit
 
         self.inner = inner  # TrainerPrograms
         self.mesh = mesh
@@ -92,10 +93,9 @@ class EnsemblePrograms:
         if mesh is None:
             self._vstep = jax.vmap(
                 inner._step_impl, in_axes=(0, None, 0, 0, 0))
-            self._jit_step = jax.jit(
-                count_traces("ens_step", self._step_shards))
-            self._jit_multi_step = jax.jit(
-                count_traces("ens_multi_step", self._multi_step_impl),
+            self._jit_step = ledger_jit("ens_step", self._step_shards)
+            self._jit_multi_step = ledger_jit(
+                "ens_multi_step", self._multi_step_impl,
                 donate_argnums=donate)
         else:
             # Batch psums cover the data axis and, when present, the seq
@@ -107,30 +107,30 @@ class EnsemblePrograms:
             self._vstep = jax.vmap(
                 functools.partial(inner._step_impl, axis=step_axes),
                 in_axes=(0, None, 0, 0, 0))
-            self._jit_step = jax.jit(count_traces(
+            self._jit_step = ledger_jit(
                 "ens_step",
-                self._shard_mapped(self._step_shards, steps_axis=False)))
-            self._jit_multi_step = jax.jit(count_traces(
+                self._shard_mapped(self._step_shards, steps_axis=False))
+            self._jit_multi_step = ledger_jit(
                 "ens_multi_step",
-                self._shard_mapped(self._multi_step_impl, steps_axis=True)),
+                self._shard_mapped(self._multi_step_impl, steps_axis=True),
                 donate_argnums=donate)
-        self._jit_forward = jax.jit(count_traces(
+        self._jit_forward = ledger_jit(
             "ens_forward",
-            jax.vmap(inner._forward_impl, in_axes=(0, None, None, None, None))))
+            jax.vmap(inner._forward_impl, in_axes=(0, None, None, None, None)))
         # Forecast-only twin: predict() consumes nothing but the scores,
         # so the sweep skips S × M per-month rank-IC/MSE sorts inside the
         # dispatch (the one-dispatch analog of the batched MC path).
-        self._jit_predict = jax.jit(count_traces(
+        self._jit_predict = ledger_jit(
             "ens_predict",
             jax.vmap(functools.partial(inner._forward_impl,
                                        scores_only=True),
-                     in_axes=(0, None, None, None, None))))
+                     in_axes=(0, None, None, None, None)))
         # Heteroscedastic twin: per-seed (mean, aleatoric variance) for
         # the uncertainty-aware aggregation (mean_minus_total_std).
-        self._jit_forward_var = jax.jit(count_traces(
+        self._jit_forward_var = ledger_jit(
             "ens_forward_var",
             jax.vmap(functools.partial(inner._forward_impl, variance=True),
-                     in_axes=(0, None, None, None, None))))
+                     in_axes=(0, None, None, None, None)))
 
     def _step_shards(self, state, dev, fi, ti, w):
         """One ensemble step over the LOCAL seed stack (the whole stack
@@ -366,16 +366,18 @@ class EnsembleTrainer:
         the device transfer so throughput accounting never forces a
         device→host sync. Thread-safe for explicit epochs (the async
         pipeline's prefetch thread builds and stages here)."""
-        per_seed = [s.stacked_epoch(epoch) for s in self.samplers]
-        k = min(b.firm_idx.shape[0] for b in per_seed)
-        fi = np.stack([b.firm_idx[:k] for b in per_seed], axis=1)
-        ti = np.stack([b.time_idx[:k] for b in per_seed], axis=1)
-        w = np.stack([b.weight[:k] for b in per_seed], axis=1)
-        fm = float(w.sum()) * self.window
-        arrays = (jnp.asarray(fi), jnp.asarray(ti), jnp.asarray(w))
-        if self.mesh is not None:
-            arrays = shard_batch(self.mesh, arrays, with_seed_axis=True,
-                                 steps_axis=True)
+        with telemetry.span("sample", epoch=epoch):
+            per_seed = [s.stacked_epoch(epoch) for s in self.samplers]
+            k = min(b.firm_idx.shape[0] for b in per_seed)
+            fi = np.stack([b.firm_idx[:k] for b in per_seed], axis=1)
+            ti = np.stack([b.time_idx[:k] for b in per_seed], axis=1)
+            w = np.stack([b.weight[:k] for b in per_seed], axis=1)
+            fm = float(w.sum()) * self.window
+        with telemetry.span("h2d", epoch=epoch):
+            arrays = (jnp.asarray(fi), jnp.asarray(ti), jnp.asarray(w))
+            if self.mesh is not None:
+                arrays = shard_batch(self.mesh, arrays, with_seed_axis=True,
+                                     steps_axis=True)
         return arrays, fm
 
     def _stacked_epoch(self, epoch: Optional[int] = None) -> Tuple:
@@ -391,10 +393,11 @@ class EnsembleTrainer:
         counters)."""
         from lfm_quant_tpu.utils.profiling import timed_device_get
 
-        b = self.val_sampler.stacked_cross_sections()
-        fi, ti, w = self.inner._batch_args(b)
-        _, ic, _ = self._jit_forward(params_stacked, self.dev, fi, ti, w)
-        ics = timed_device_get(ic)  # [S, M]
+        with telemetry.span("eval", cat="eval"):
+            b = self.val_sampler.stacked_cross_sections()
+            fi, ti, w = self.inner._batch_args(b)
+            _, ic, _ = self._jit_forward(params_stacked, self.dev, fi, ti, w)
+            ics = timed_device_get(ic)  # [S, M]
         counts = b.weight.sum(axis=1)  # [M]
         per_seed = (ics * counts).sum(axis=1) / counts.sum()
         return {"ic_per_seed": per_seed, "ic_mean": float(per_seed.mean()),
@@ -413,6 +416,14 @@ class EnsembleTrainer:
 
         ``init_params``: seed-stacked [S, ...] params to start from (the
         walk-forward warm start); optimizer state restarts fresh."""
+        with telemetry.span("fit", cat="fit", kind="ensemble",
+                            n_seeds=self.n_seeds) as sp:
+            out = self._fit_impl(resume, init_params)
+            sp.set(epochs_run=out["epochs_run"],
+                   best_epoch=out["best_epoch"])
+            return out
+
+    def _fit_impl(self, resume: bool, init_params) -> Dict[str, Any]:
         from lfm_quant_tpu.train import pipeline
 
         cfg = self.cfg

@@ -44,6 +44,7 @@ from lfm_quant_tpu.ops import (
     spearman_ic,
 )
 from lfm_quant_tpu.train.checkpoint import CheckpointManager
+from lfm_quant_tpu.utils import telemetry
 from lfm_quant_tpu.utils.logging import MetricsLogger
 from lfm_quant_tpu.utils.profiling import StepTimer, timed_device_get
 
@@ -344,7 +345,7 @@ class TrainerPrograms:
     def __init__(self, cfg: RunConfig, mesh: Any, n_seq: int,
                  steps_per_epoch: int, gather_impl: str,
                  eval_gather_impl: str, eval_gather_sharded: str, fp: int):
-        from lfm_quant_tpu.utils.profiling import count_traces
+        from lfm_quant_tpu.train.reuse import ledger_jit
 
         self.cfg = cfg
         self.mesh = mesh
@@ -409,37 +410,37 @@ class TrainerPrograms:
 
         donate = multi_step_donate_argnums()
         if mesh is None:
-            self._jit_step = jax.jit(count_traces("step", self._step_impl))
-            self._jit_multi_step = jax.jit(
-                count_traces("multi_step", self._multi_step_impl),
+            self._jit_step = ledger_jit("step", self._step_impl)
+            self._jit_multi_step = ledger_jit(
+                "multi_step", self._multi_step_impl,
                 donate_argnums=donate)
         else:
             # shard_map over the date axis: each shard gathers and runs the
             # model locally (Pallas kernels legal), with explicit psums for
             # the global loss/gradients — numerically the same weighted
             # means GSPMD computed, up to reduction order.
-            self._jit_step = jax.jit(count_traces("step", self._shard_mapped(
-                self._step_impl, steps_axis=False)))
-            self._jit_multi_step = jax.jit(count_traces(
+            self._jit_step = ledger_jit("step", self._shard_mapped(
+                self._step_impl, steps_axis=False))
+            self._jit_multi_step = ledger_jit(
                 "multi_step",
-                self._shard_mapped(self._multi_step_impl, steps_axis=True)),
+                self._shard_mapped(self._multi_step_impl, steps_axis=True),
                 donate_argnums=donate)
-        self._jit_forward = jax.jit(
-            count_traces("forward", self._forward_impl),
+        self._jit_forward = ledger_jit(
+            "forward", self._forward_impl,
             static_argnames=("variance",))
         # Batched MC-dropout: the eval forward vmapped over a stacked key
         # array, so K samples are ONE dispatch (and ONE D2H in predict)
         # instead of K serial dispatches each paying tunnel latency.
-        self._jit_mc_forward = jax.jit(count_traces(
-            "mc_forward", self._mc_forward_impl))
+        self._jit_mc_forward = ledger_jit(
+            "mc_forward", self._mc_forward_impl)
         # Forecast-only twin (scores_only): predict() consumes nothing
         # but the scores, so the serving sweep skips M wasted per-month
         # rank-IC sorts + MSE inside the dispatch — the single-seed
         # analog of the ensemble's _jit_predict.
-        self._jit_predict = jax.jit(count_traces(
+        self._jit_predict = ledger_jit(
             "predict",
             lambda params, dev, fi, ti, w: self._forward_impl(
-                params, dev, fi, ti, w, scores_only=True)))
+                params, dev, fi, ti, w, scores_only=True))
         # Month-sharded eval: under a data mesh the plain jitted forward
         # would replicate the whole sweep on every device; shard_map over
         # the stacked month axis makes eval/backtest scale with the data
@@ -460,9 +461,9 @@ class TrainerPrograms:
                 in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS),
                           P(DATA_AXIS)),
                 check_vma=False)
-            self._jit_fwd_det = jax.jit(count_traces("fwd_det", sharded(
+            self._jit_fwd_det = ledger_jit("fwd_det", sharded(
                 functools.partial(self._forward_impl, axis=DATA_AXIS),
-                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P()))))
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS), P())))
 
             def fwd_var(params, dev, fi, ti, w):
                 # axis marks this as a SHARDED dispatch (gather promotion
@@ -473,8 +474,8 @@ class TrainerPrograms:
                                                   axis=DATA_AXIS)
                 return mean, var
 
-            self._jit_fwd_var = jax.jit(count_traces("fwd_var", sharded(
-                fwd_var, out_specs=(P(DATA_AXIS), P(DATA_AXIS)))))
+            self._jit_fwd_var = ledger_jit("fwd_var", sharded(
+                fwd_var, out_specs=(P(DATA_AXIS), P(DATA_AXIS))))
 
     def _shard_mapped(self, impl, steps_axis: bool):
         """Wrap a step impl in shard_map over this program set's mesh.
@@ -1068,10 +1069,11 @@ class Trainer:
         ``np.asarray(ic)`` + ``float(mse)`` pair paid dispatch-path
         latency twice)."""
         sampler = sampler or self.val_sampler
-        b = sampler.stacked_cross_sections()
-        _, ic, mse = self._forward_eval(state_params, b)
-        counts = b.weight.sum(axis=1)
-        ic, mse = timed_device_get((ic, mse))
+        with telemetry.span("eval", cat="eval"):
+            b = sampler.stacked_cross_sections()
+            _, ic, mse = self._forward_eval(state_params, b)
+            counts = b.weight.sum(axis=1)
+            ic, mse = timed_device_get((ic, mse))
         return {
             "ic": float(np.average(ic, weights=counts)),
             "mse": float(mse),
@@ -1103,6 +1105,13 @@ class Trainer:
         RECORDED epoch's snapshot, so predict/warm-start consumers see
         the same state in either mode (and with a run dir, finalize
         restores the best checkpoint on top, exactly as before)."""
+        with telemetry.span("fit", cat="fit", kind="trainer") as sp:
+            out = self._fit_impl(resume, init_params)
+            sp.set(epochs_run=out["epochs_run"],
+                   best_epoch=out["best_epoch"])
+            return out
+
+    def _fit_impl(self, resume: bool, init_params) -> Dict[str, Any]:
         from lfm_quant_tpu.train import pipeline
 
         cfg = self.cfg
@@ -1145,10 +1154,15 @@ class Trainer:
 
         def build(epoch):
             # Whole epoch as one [K, D, Bf] index stack; firm-months are
-            # known on the host before any device work.
-            b = self.train_sampler.stacked_epoch(epoch)
-            fm = float(b.weight.sum()) * self.window
-            return self._batch_args(b, train=True, steps=True), fm
+            # known on the host before any device work. The two spans
+            # split host sampling from H2D staging (they emit on the
+            # prefetch thread under LFM_ASYNC).
+            with telemetry.span("sample", epoch=epoch):
+                b = self.train_sampler.stacked_epoch(epoch)
+                fm = float(b.weight.sum()) * self.window
+            with telemetry.span("h2d", epoch=epoch):
+                args = self._batch_args(b, train=True, steps=True)
+            return args, fm
 
         def dispatch(state, args):
             # Train epoch + chained validation sweep on one stream; no

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.utils import telemetry
 from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS, timed_device_get
 
 
@@ -77,7 +78,11 @@ class EpochPrefetcher:
 
         def run():
             try:
-                out["result"] = self._build(epoch)
+                # The build callback's own sample/h2d spans emit on this
+                # thread; the wrapper span shows the prefetch window
+                # itself overlapping the in-flight epoch in the trace.
+                with telemetry.span("prefetch", cat="sample", epoch=epoch):
+                    out["result"] = self._build(epoch)
             except BaseException as e:  # noqa: BLE001 — re-raised in get()
                 out["error"] = e
 
@@ -111,13 +116,15 @@ class EpochPrefetcher:
 
 class _InFlight(NamedTuple):
     """A dispatched-but-unsynced epoch: the device scalars to fetch, the
-    state snapshot checkpointing will read, and the host-known
-    firm-month count for throughput accounting."""
+    state snapshot checkpointing will read, the host-known firm-month
+    count for throughput accounting, and the epoch's telemetry span
+    (begun at dispatch; closed when the epoch settles)."""
 
     epoch: int
     vals: Dict[str, Any]
     snap: Any
     fm: float
+    span: Any
 
 
 def _snapshot(state, checkpointing: bool, async_mode: bool):
@@ -187,16 +194,20 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
         nonlocal drained_at
         snap_dict = (p.snap._asdict()
                      if checkpointing and p.snap is not None else None)
-        if snap_dict is not None and reuse.async_ckpt_enabled():
-            host_vals, snap_dict = timed_device_get((p.vals, snap_dict))
-        else:
-            host_vals = timed_device_get(p.vals)
+        with telemetry.span("eval_sync", epoch=p.epoch):
+            if snap_dict is not None and reuse.async_ckpt_enabled():
+                host_vals, snap_dict = timed_device_get((p.vals, snap_dict))
+            else:
+                host_vals = timed_device_get(p.vals)
         if drained:
             drained_at = time.perf_counter()
         timer.stop(firm_months=p.fm)
         timer.start()
         step, val_ic = finish(p.epoch, host_vals, p.fm)
-        return harness.end_epoch(p.epoch, step, snap_dict, val_ic)
+        with telemetry.span("ckpt", epoch=p.epoch, step=step):
+            stop = harness.end_epoch(p.epoch, step, snap_dict, val_ic)
+        p.span.end(val_ic=round(val_ic, 6), stop=stop)
+        return stop
 
     # Async-mode idle probe: (timestamp, was-the-in-flight-epoch-done)
     # sampled at the END of each loop iteration. If the in-flight epoch
@@ -212,8 +223,11 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
     overrun: Optional[int] = None
     try:
         while epoch is not None:
-            batches, fm = (prefetch.get(epoch) if prefetch is not None
-                           else build(epoch))
+            if prefetch is not None:
+                with telemetry.span("sample_wait", epoch=epoch):
+                    batches, fm = prefetch.get(epoch)
+            else:
+                batches, fm = build(epoch)
             if drained_at is not None:
                 REUSE_COUNTERS.device_idle_s += (
                     time.perf_counter() - drained_at)
@@ -222,10 +236,16 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
                 REUSE_COUNTERS.device_idle_s += (
                     time.perf_counter() - probe[0])
             probe = None
-            state, vals = dispatch(state, batches)
-            snap = _snapshot(state, checkpointing, async_mode)
+            # Epoch span: dispatch → settle. Under lookahead these
+            # OVERLAP (epoch e+1 dispatches before e settles), hence an
+            # async telemetry span, not a nested one.
+            esp = telemetry.begin_async("epoch", epoch=epoch)
+            with telemetry.span("dispatch", epoch=epoch):
+                state, vals = dispatch(state, batches)
+                snap = _snapshot(state, checkpointing, async_mode)
             if not async_mode:
-                if settle(_InFlight(epoch, vals, snap, fm), drained=True):
+                if settle(_InFlight(epoch, vals, snap, fm, esp),
+                          drained=True):
                     break
                 epoch = harness.next_epoch()
                 continue
@@ -245,6 +265,8 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
                     # walk-forward warm starts) see the same state the
                     # lock-step loop would have ended on.
                     overrun = epoch
+                    esp.end(discarded=True)
+                    telemetry.instant("lookahead_overrun", epoch=epoch)
                     if inflight.snap is not None:
                         state = inflight.snap
                     inflight = None
@@ -254,7 +276,7 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
                     raise RuntimeError(
                         f"pipeline epoch skew: dispatched {epoch}, "
                         f"harness advanced to {stepped}")
-            inflight = _InFlight(epoch, vals, snap, fm)
+            inflight = _InFlight(epoch, vals, snap, fm, esp)
             probe = (time.perf_counter(), _all_ready(vals))
             epoch = cand
         if inflight is not None:

@@ -53,8 +53,10 @@ fresh wrappers) — the A/B switch the numerical-identity tests use.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from lfm_quant_tpu.utils import telemetry
 from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
 
 _PROGRAM_CACHE: Dict[Tuple, Any] = {}
@@ -227,6 +229,145 @@ def clear_program_cache() -> None:
 
 def program_cache_size() -> int:
     return len(_PROGRAM_CACHE)
+
+
+# ---- program ledger -----------------------------------------------------
+
+
+class _LedgeredJit:
+    """A jitted program wrapped for the telemetry program ledger.
+
+    Warm calls pay one counter read + compare on top of the jit
+    dispatch (nanoseconds against a multi-ms dispatch). A call that
+    TRACED (detected via the :func:`count_traces` counter delta —
+    Python trace == fresh XLA compile for these programs) records a
+    ledger entry: compile wall seconds (the whole first-call elapsed —
+    trace + lower + XLA compile; jit blocks on compilation before
+    dispatching) and, when a telemetry run is active, the program's XLA
+    ``cost_analysis`` FLOPs/bytes and ``memory_analysis`` HBM footprint
+    via the AOT API on the post-call avals (donated buffers keep their
+    shape/dtype/sharding, so this never touches data). The analysis
+    re-lower runs under ``suspend_trace_counting`` — it is ledger
+    bookkeeping, not a new program on the training path, and the reuse
+    lane's zero-trace contract must not see it.
+
+    Everything analysis-shaped is guarded for jax-0.4.x availability:
+    any step that raises degrades to an entry without those fields."""
+
+    __slots__ = ("name", "_jitted")
+
+    def __init__(self, name: str, jitted: Any):
+        self.name = name
+        self._jitted = jitted
+
+    def __call__(self, *args, **kwargs):
+        if not telemetry.enabled():
+            return self._jitted(*args, **kwargs)
+        before = telemetry.COUNTERS.get("jit_traces")
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        traces = telemetry.COUNTERS.get("jit_traces") - before
+        if traces:
+            self._record(args, kwargs, time.perf_counter() - t0, traces)
+        return out
+
+    def lower(self, *args, **kwargs):
+        """AOT passthrough (tests/tooling)."""
+        return self._jitted.lower(*args, **kwargs)
+
+    def _record(self, args, kwargs, compile_s: float, traces: int) -> None:
+        entry: Dict[str, Any] = {"program": self.name,
+                                 "compile_s": round(compile_s, 6),
+                                 "traces": traces}
+        try:
+            import jax
+
+            leaves = [x for x in jax.tree.leaves(args)
+                      if hasattr(x, "shape") and hasattr(x, "dtype")]
+            entry["arg_leaves"] = len(leaves)
+            entry["arg_bytes"] = int(sum(
+                x.size * x.dtype.itemsize for x in leaves))
+        except Exception:
+            pass
+        if telemetry.analysis_active():
+            entry.update(self._analyze(args, kwargs))
+        telemetry.record_program_build(entry)
+
+    def _analyze(self, args, kwargs) -> Dict[str, Any]:
+        """XLA cost analysis of the just-compiled signature — a cheap
+        re-lower (the jaxpr/lowering caches usually hit). The
+        ``memory_analysis`` HBM footprint needs ``lowered.compile()``,
+        a SECOND full XLA compile per program: with default-on
+        telemetry every production run has an active telemetry run, so
+        that cost would land synchronously on every cold start — it is
+        therefore opt-in (``LFM_TELEMETRY_ANALYSIS=1``); the always-
+        recorded ``arg_bytes`` serves as the resident-footprint proxy
+        otherwise."""
+        out: Dict[str, Any] = {}
+        try:
+            import jax
+
+            from lfm_quant_tpu.utils.profiling import suspend_trace_counting
+
+            def to_aval(x):
+                if not (hasattr(x, "shape") and hasattr(x, "dtype")):
+                    return x
+                sharding = getattr(x, "sharding", None)
+                if not isinstance(sharding, jax.sharding.NamedSharding):
+                    sharding = None
+                return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                            sharding=sharding)
+
+            avals = jax.tree.map(to_aval, args)
+            with suspend_trace_counting():
+                lowered = self._jitted.lower(*avals, **kwargs)
+                try:
+                    cost = lowered.cost_analysis() or {}
+                    if isinstance(cost, (list, tuple)):
+                        cost = cost[0] if cost else {}
+                    for src, dst in (("flops", "flops"),
+                                     ("bytes accessed", "bytes_accessed"),
+                                     ("transcendentals", "transcendentals")):
+                        if src in cost:
+                            out[dst] = float(cost[src])
+                except Exception as e:  # noqa: BLE001 — availability guard
+                    out["cost_analysis_error"] = type(e).__name__
+                if not telemetry.deep_analysis_active():
+                    return out
+                try:
+                    mem = lowered.compile().memory_analysis()
+                    for attr in ("generated_code_size_in_bytes",
+                                 "argument_size_in_bytes",
+                                 "output_size_in_bytes",
+                                 "temp_size_in_bytes",
+                                 "alias_size_in_bytes"):
+                        v = getattr(mem, attr, None)
+                        if v is not None:
+                            out[attr.replace("_size_in_bytes", "_bytes")] = \
+                                int(v)
+                    hbm = sum(out.get(k, 0) for k in
+                              ("generated_code_bytes", "argument_bytes",
+                               "output_bytes", "temp_bytes"))
+                    hbm -= out.get("alias_bytes", 0)
+                    out["hbm_bytes"] = max(0, int(hbm))
+                except Exception as e:  # noqa: BLE001 — availability guard
+                    out["memory_analysis_error"] = type(e).__name__
+        except Exception as e:  # noqa: BLE001 — never kill a dispatch
+            out["analysis_error"] = type(e).__name__
+        return out
+
+
+def ledger_jit(name: str, fn: Callable, **jit_kwargs) -> _LedgeredJit:
+    """``jax.jit`` + :func:`count_traces` + program-ledger recording in
+    one wrapper — the construction every reuse-layer program goes
+    through, so the ledger covers exactly the programs the compiled-
+    program cache manages (plus any other caller that opts in, e.g. the
+    fused backtest core)."""
+    import jax
+
+    from lfm_quant_tpu.utils.profiling import count_traces
+
+    return _LedgeredJit(name, jax.jit(count_traces(name, fn), **jit_kwargs))
 
 
 _PERSISTENT_CACHE_DIR: Optional[str] = None

@@ -255,6 +255,7 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     """
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
     from lfm_quant_tpu.train.loop import Trainer
+    from lfm_quant_tpu.utils import telemetry
     from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
 
     folds = walkforward_folds(panel, start, step_months, val_months, n_folds)
@@ -311,99 +312,106 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
             continue  # fold already completed in a previous run
         # Per-fold compile/transfer accounting: the deltas land in the
         # fold record, making the reuse layer's zero-recompile claim a
-        # measured per-fold property.
-        reuse_snap = REUSE_COUNTERS.snapshot()
-        train_start = (month_add(train_end, -train_months)
-                       if train_months else None)
-        splits = PanelSplits.by_date(panel, train_end, val_end,
-                                     train_start=train_start)
-        run_dir = os.path.join(out_dir, f"fold_{k}") if out_dir else None
-        # Per-fold seed offset keeps fold models independent draws while
-        # staying replayable.
-        fold_cfg = dataclasses.replace(cfg, seed=cfg.seed + 1000 * k)
-        if run_dir:
-            # Make every fold dir a standalone loadable run dir
-            # (load_trainer/load_ensemble): config.json pins the FOLD's
-            # split boundaries so a reload reconstructs the exact
-            # training-time splits, and the ensemble marker routes
-            # load_forecaster. Written BEFORE fit so a crashed fold is
-            # still inspectable. forecast.py uses the LAST fold — the
-            # model trained on the most recent data — for live rankings.
-            from lfm_quant_tpu.train.forecast import mark_ensemble_run_dir
+        # measured per-fold property. The fold telemetry span carries
+        # the same deltas per-span (run → fold → fit → epoch hierarchy).
+        with telemetry.span("fold", cat="fold", fold=k,
+                            train_end=train_end,
+                            val_end=val_end) as fold_span:
+            reuse_snap = REUSE_COUNTERS.snapshot()
+            train_start = (month_add(train_end, -train_months)
+                           if train_months else None)
+            splits = PanelSplits.by_date(panel, train_end, val_end,
+                                         train_start=train_start)
+            run_dir = os.path.join(out_dir, f"fold_{k}") if out_dir else None
+            # Per-fold seed offset keeps fold models independent draws while
+            # staying replayable.
+            fold_cfg = dataclasses.replace(cfg, seed=cfg.seed + 1000 * k)
+            if run_dir:
+                # Make every fold dir a standalone loadable run dir
+                # (load_trainer/load_ensemble): config.json pins the FOLD's
+                # split boundaries so a reload reconstructs the exact
+                # training-time splits, and the ensemble marker routes
+                # load_forecaster. Written BEFORE fit so a crashed fold is
+                # still inspectable. forecast.py uses the LAST fold — the
+                # model trained on the most recent data — for live rankings.
+                from lfm_quant_tpu.train.forecast import mark_ensemble_run_dir
 
-            os.makedirs(run_dir, exist_ok=True)
-            save_cfg = dataclasses.replace(
-                fold_cfg, data=dataclasses.replace(
-                    fold_cfg.data, train_end=train_end, val_end=val_end,
-                    train_start=train_start))
-            with open(os.path.join(run_dir, "config.json"), "w") as fh:
-                fh.write(save_cfg.to_json())
-            # Also CLEARS a stale flag when a reused dir flips trainer
-            # kind between runs.
-            mark_ensemble_run_dir(run_dir, ensemble)
-        # ONE trainer for the whole sweep, rebound per fold: rebind()
-        # resets TrainState, sampler seeds and split boundaries without
-        # rebuilding the jit wrappers (an unchanged program key keeps the
-        # exact executables; a changed one rebuilds through the cache —
-        # never stale reuse). Constructing fresh trainers would reuse
-        # programs too (the caches are module-level), but rebind keeps
-        # the sweep's intent explicit and skips re-running construction-
-        # time validation per fold.
-        if trainer is None:
-            trainer = (EnsembleTrainer if ensemble else Trainer)(
-                fold_cfg, splits, run_dir=run_dir, echo=echo)
-        else:
-            trainer.rebind(fold_cfg, splits, run_dir=run_dir)
-        if warm_start and prev_params is None and k > 0 and out_dir:
-            # The in-memory carry is gone (folds skipped by resume in
-            # this process) — restore the predecessor fold's best params
-            # from its run dir so the chain survives crash recovery.
-            prev_params = _load_fold_best_params(
-                trainer, os.path.join(out_dir, f"fold_{k - 1}"))
-        used_warm = warm_start and prev_params is not None
-        fit = trainer.fit(resume=resume and run_dir is not None,
-                          init_params=prev_params if used_warm else None)
-        if warm_start:
-            # Best state when this fold had a run dir (finalize restored
-            # ckpt/best); the last-epoch state otherwise — see docstring.
-            prev_params = trainer.state.params
-        if het:
-            fc, avar, v = trainer.predict(date_range=pred_range,
-                                          return_variance=True)
-            variance[..., v] = avar[..., v]
-        else:
-            fc, v = trainer.predict(date_range=pred_range)
-        assert not (valid & v).any(), "fold prediction windows overlap"
-        forecast[..., v] = fc[..., v]
-        valid |= v
-        records.append({
-            "fold": k,
-            "train_end": train_end,
-            "val_end": val_end,
-            "pred_months": [int(panel.dates[pred_range[0]]),
-                            int(panel.dates[pred_range[1] - 1])],
-            "n_pred_cells": int(v.sum()),
-            "best_val_ic": fit["best_val_ic"],
-            "epochs_run": fit["epochs_run"],
-            "warm_started": used_warm,
-            # Fold-level compile/transfer cost: 0 jit_traces and 0
-            # panel_transfers on every fold after the first is the reuse
-            # layer's contract on a same-shape schedule (tests/test_reuse
-            # and bench.py walkforward_reuse assert it here). The same
-            # delta carries the epoch pipeline's sync-point accounting
-            # (host_syncs / host_sync_s / device_idle_s — one blocking
-            # fetch per epoch, near-zero idle with LFM_ASYNC on), so
-            # every fold record prices its host-sync overhead too.
-            "reuse": {k: (round(v, 4) if isinstance(v, float) else v)
-                      for k, v in REUSE_COUNTERS.delta(reuse_snap).items()},
-        })
-        if out_dir:
-            os.makedirs(out_dir, exist_ok=True)
-            extra = {"variance": variance} if het else {}
-            np.savez_compressed(partial_npz, forecast=forecast, valid=valid,
-                                **extra)
-            with open(partial_json, "w") as fh:
-                json.dump(records, fh)
+                os.makedirs(run_dir, exist_ok=True)
+                save_cfg = dataclasses.replace(
+                    fold_cfg, data=dataclasses.replace(
+                        fold_cfg.data, train_end=train_end, val_end=val_end,
+                        train_start=train_start))
+                with open(os.path.join(run_dir, "config.json"), "w") as fh:
+                    fh.write(save_cfg.to_json())
+                # Also CLEARS a stale flag when a reused dir flips trainer
+                # kind between runs.
+                mark_ensemble_run_dir(run_dir, ensemble)
+            # ONE trainer for the whole sweep, rebound per fold: rebind()
+            # resets TrainState, sampler seeds and split boundaries without
+            # rebuilding the jit wrappers (an unchanged program key keeps the
+            # exact executables; a changed one rebuilds through the cache —
+            # never stale reuse). Constructing fresh trainers would reuse
+            # programs too (the caches are module-level), but rebind keeps
+            # the sweep's intent explicit and skips re-running construction-
+            # time validation per fold.
+            if trainer is None:
+                trainer = (EnsembleTrainer if ensemble else Trainer)(
+                    fold_cfg, splits, run_dir=run_dir, echo=echo)
+            else:
+                trainer.rebind(fold_cfg, splits, run_dir=run_dir)
+            if warm_start and prev_params is None and k > 0 and out_dir:
+                # The in-memory carry is gone (folds skipped by resume in
+                # this process) — restore the predecessor fold's best params
+                # from its run dir so the chain survives crash recovery.
+                prev_params = _load_fold_best_params(
+                    trainer, os.path.join(out_dir, f"fold_{k - 1}"))
+            used_warm = warm_start and prev_params is not None
+            fit = trainer.fit(resume=resume and run_dir is not None,
+                              init_params=prev_params if used_warm else None)
+            if warm_start:
+                # Best state when this fold had a run dir (finalize restored
+                # ckpt/best); the last-epoch state otherwise — see docstring.
+                prev_params = trainer.state.params
+            with telemetry.span("predict", cat="predict", fold=k):
+                if het:
+                    fc, avar, v = trainer.predict(date_range=pred_range,
+                                                  return_variance=True)
+                    variance[..., v] = avar[..., v]
+                else:
+                    fc, v = trainer.predict(date_range=pred_range)
+            assert not (valid & v).any(), "fold prediction windows overlap"
+            forecast[..., v] = fc[..., v]
+            valid |= v
+            records.append({
+                "fold": k,
+                "train_end": train_end,
+                "val_end": val_end,
+                "pred_months": [int(panel.dates[pred_range[0]]),
+                                int(panel.dates[pred_range[1] - 1])],
+                "n_pred_cells": int(v.sum()),
+                "best_val_ic": fit["best_val_ic"],
+                "epochs_run": fit["epochs_run"],
+                "warm_started": used_warm,
+                # Fold-level compile/transfer cost: 0 jit_traces and 0
+                # panel_transfers on every fold after the first is the reuse
+                # layer's contract on a same-shape schedule (tests/test_reuse
+                # and bench.py walkforward_reuse assert it here). The same
+                # delta carries the epoch pipeline's sync-point accounting
+                # (host_syncs / host_sync_s / device_idle_s — one blocking
+                # fetch per epoch, near-zero idle with LFM_ASYNC on), so
+                # every fold record prices its host-sync overhead too.
+                "reuse": {k: (round(v, 4) if isinstance(v, float) else v)
+                          for k, v in REUSE_COUNTERS.delta(reuse_snap).items()},
+            })
+            if out_dir:
+                os.makedirs(out_dir, exist_ok=True)
+                extra = {"variance": variance} if het else {}
+                np.savez_compressed(partial_npz, forecast=forecast, valid=valid,
+                                    **extra)
+                with open(partial_json, "w") as fh:
+                    json.dump(records, fh)
+            fold_span.set(epochs_run=fit["epochs_run"],
+                          warm_started=used_warm)
     summary = {
         "n_folds": len(folds),
         "step_months": step_months,
@@ -436,8 +444,10 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
         # panel through the fused scoring path (numpy fallback when the
         # LFM_JAX_BACKTEST knob is off); only summary.json needs the
         # re-write (the npz would just recompress identical arrays).
-        summary["backtest"] = score_stitched(
-            forecast, valid, panel, score_modes, variance=variance,
-            **(score_kwargs or {}))
+        with telemetry.span("score", cat="score",
+                            n_modes=len(score_modes)):
+            summary["backtest"] = score_stitched(
+                forecast, valid, panel, score_modes, variance=variance,
+                **(score_kwargs or {}))
         _save_summary()
     return forecast, valid, summary

@@ -1,5 +1,7 @@
-"""Utilities: throughput/profiling harness, structured metric logging."""
+"""Utilities: throughput/profiling harness, structured metric logging,
+and the unified run-telemetry layer (spans, counters, manifests)."""
 
+from lfm_quant_tpu.utils import telemetry
 from lfm_quant_tpu.utils.debug import assert_finite_tree, sanitized
 from lfm_quant_tpu.utils.logging import MetricsLogger
 from lfm_quant_tpu.utils.profiling import StepTimer, trace_context
@@ -7,6 +9,7 @@ from lfm_quant_tpu.utils.profiling import StepTimer, trace_context
 __all__ = [
     "MetricsLogger",
     "StepTimer",
+    "telemetry",
     "trace_context",
     "sanitized",
     "assert_finite_tree",

@@ -9,13 +9,38 @@ TF (BASELINE.json:5 "no GPU/TF in the loop").
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 from typing import Any, Dict, Optional
 
 
+def _finite(v: Any) -> Any:
+    """Non-finite floats → None, RECURSIVELY through containers:
+    ``json.dumps(float("nan"))`` emits a bare ``NaN`` token, which is
+    NOT valid JSON — a NaN'd val_ic would corrupt the ``metrics.jsonl``
+    line that crash-resume reconciliation (train/loop.py
+    ``FitHarness._recover_best``) and every strict-JSON consumer reads.
+    ``null`` round-trips everywhere and is unambiguous in the stream.
+    The recursion depth must match the ``allow_nan=False`` strictness
+    the writers enforce — a NaN nested in a logged list must sanitize,
+    not raise. Shared by the telemetry span/trace emitters
+    (utils/telemetry.py), which state the same contract."""
+    if isinstance(v, float):
+        return v if math.isfinite(v) else None
+    if isinstance(v, (list, tuple)):
+        return [_finite(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _finite(x) for k, x in v.items()}
+    return v
+
+
 class MetricsLogger:
-    """Append-only JSONL metric stream (one dict per line, ts + step added)."""
+    """Append-only JSONL metric stream (one dict per line, ts + step added).
+
+    Every line is STRICT JSON: non-finite floats are serialized as
+    ``null`` (see :func:`_finite`); the dict returned to the caller
+    keeps the original values."""
 
     def __init__(self, run_dir: Optional[str], filename: str = "metrics.jsonl",
                  echo: bool = False):
@@ -32,11 +57,13 @@ class MetricsLogger:
             {k: (float(v) if hasattr(v, "__float__") else v)
              for k, v in metrics.items()}
         )
-        if self._fh:
-            self._fh.write(json.dumps(rec) + "\n")
-        if self.echo:
-            shown = {k: v for k, v in rec.items() if k != "ts"}
-            print(json.dumps(shown))
+        if self._fh or self.echo:
+            line = {k: _finite(v) for k, v in rec.items()}
+            if self._fh:
+                self._fh.write(json.dumps(line, allow_nan=False) + "\n")
+            if self.echo:
+                shown = {k: v for k, v in line.items() if k != "ts"}
+                print(json.dumps(shown, allow_nan=False))
         return rec
 
     def close(self):

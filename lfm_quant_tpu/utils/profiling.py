@@ -10,17 +10,26 @@ Definition used throughout: one *firm-month* = one (firm, month) panel
 observation consumed by the model. A training step over ``B`` windows of
 length ``W`` with ``v`` real (non-padded) samples processes ``v × W``
 firm-months.
+
+Since the unified telemetry layer (utils/telemetry.py), the counters
+here are a fixed-field **view** over the process-wide named-counter
+registry ``telemetry.COUNTERS``: every bump lands in the registry, so
+spans get per-span counter deltas while ``REUSE_COUNTERS``'s
+snapshot/delta surface (and every lane that asserts on it) keeps
+working unchanged.
 """
 
 from __future__ import annotations
 
 import contextlib
-import dataclasses
 import functools
 import time
-from typing import Callable, Dict, Optional
+import warnings
+from typing import Callable, Dict, Optional, Union
 
 import jax
+
+from lfm_quant_tpu.utils.telemetry import COUNTERS
 
 
 @contextlib.contextmanager
@@ -63,6 +72,18 @@ class StepTimer:
         self._t0 = time.perf_counter()
 
     def stop(self, device_out=None, firm_months: float = 0.0):
+        """Record one interval since :meth:`start` (blocking on
+        ``device_out`` first). Calling it with no matching ``start()``
+        ever issued is a caller bug, but an interval of "since the
+        epoch" would silently poison every later throughput number — so
+        it warns and records nothing instead of raising an opaque
+        ``TypeError`` off ``None`` arithmetic."""
+        if self._t0 is None:
+            warnings.warn(
+                "StepTimer.stop() called before start() — no interval is "
+                "open; ignoring this stop (throughput unchanged)",
+                RuntimeWarning, stacklevel=2)
+            return 0.0
         if device_out is not None:
             jax.block_until_ready(device_out)
         dt = time.perf_counter() - self._t0
@@ -76,7 +97,6 @@ class StepTimer:
         return self.firm_months / self.seconds if self.seconds > 0 else 0.0
 
 
-@dataclasses.dataclass
 class ReuseCounters:
     """Process-wide compile/transfer accounting for the cross-fold reuse
     layer (train/reuse.py). The point of the walk-forward reuse work is
@@ -85,6 +105,11 @@ class ReuseCounters:
     records in train/walkforward.py, the ``walkforward_reuse`` bench
     metric, and the ``reuse``-marked regression tests) instead of a
     claim.
+
+    Storage lives in ``telemetry.COUNTERS`` (each field is a property
+    over the registry), so the same counters feed per-span deltas in the
+    telemetry layer; this class is the stable fixed-field view the reuse
+    and pipeline lanes assert against.
 
     * ``jit_traces`` — number of times a reuse-layer jitted program was
       (re)traced. Python trace == XLA (re)compile for these programs:
@@ -99,13 +124,13 @@ class ReuseCounters:
       bound an already-resident panel instead of re-transferring).
     * ``host_syncs`` / ``host_sync_s`` — blocking device→host fetches on
       the training path (:func:`timed_device_get`) and the wall seconds
-      spent blocked in them. The async epoch pipeline's contract is ONE
-      such fetch per epoch (loss + grad-norm + per-month val IC + mse +
-      step in a single ``jax.device_get``) instead of a scatter of
+      (float) spent blocked in them. The async epoch pipeline's contract
+      is ONE such fetch per epoch (loss + grad-norm + per-month val IC +
+      mse + step in a single ``jax.device_get``) instead of a scatter of
       ``float()``/``np.asarray`` syncs.
-    * ``device_idle_s`` — host-observed device-idle seconds. Lock-step
-      mode: the gap between draining the dispatch pipeline (an epoch's
-      scalars fetched with nothing else in flight) and the next
+    * ``device_idle_s`` — host-observed device-idle seconds (float).
+      Lock-step mode: the gap between draining the dispatch pipeline (an
+      epoch's scalars fetched with nothing else in flight) and the next
       dispatch — the serial host window (sampling, eval sync,
       checkpoint writes) the one-epoch-lookahead pipeline
       (train/pipeline.py, ``LFM_ASYNC``) exists to hide. Async mode: a
@@ -116,27 +141,36 @@ class ReuseCounters:
       non-zero means real measured idle; zero means none observed.
     """
 
-    jit_traces: int = 0
-    panel_transfers: int = 0
-    panel_bytes: int = 0
-    program_cache_hits: int = 0
-    program_cache_misses: int = 0
-    panel_cache_hits: int = 0
-    host_syncs: int = 0
-    host_sync_s: float = 0.0
-    device_idle_s: float = 0.0
+    _FIELDS = ("jit_traces", "panel_transfers", "panel_bytes",
+               "program_cache_hits", "program_cache_misses",
+               "panel_cache_hits", "host_syncs", "host_sync_s",
+               "device_idle_s")
 
-    def snapshot(self) -> Dict[str, int]:
-        return dataclasses.asdict(self)
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        """Current value of every field (``host_sync_s`` /
+        ``device_idle_s`` are float seconds; the rest int counts)."""
+        get = COUNTERS.get
+        return {f: get(f) for f in self._FIELDS}
 
-    def delta(self, since: Dict[str, int]) -> Dict[str, int]:
+    def delta(self, since: Dict[str, Union[int, float]]
+              ) -> Dict[str, Union[int, float]]:
         """Counter increments since a :meth:`snapshot`."""
         now = self.snapshot()
         return {k: now[k] - since.get(k, 0) for k in now}
 
     def reset(self) -> None:
-        for f in dataclasses.fields(self):
-            setattr(self, f.name, 0)
+        for f in self._FIELDS:
+            COUNTERS.set(f, 0)
+
+
+def _field_property(name: str) -> property:
+    return property(lambda self: COUNTERS.get(name),
+                    lambda self, v: COUNTERS.set(name, v))
+
+
+for _f in ReuseCounters._FIELDS:
+    setattr(ReuseCounters, _f, _field_property(_f))
+del _f
 
 
 #: The process-wide instance every hook point bumps. Deltas (snapshot /
@@ -154,9 +188,30 @@ def timed_device_get(tree):
     ``epoch_pipeline`` bench metric) instead of a claim."""
     t0 = time.perf_counter()
     out = jax.device_get(tree)
-    REUSE_COUNTERS.host_syncs += 1
-    REUSE_COUNTERS.host_sync_s += time.perf_counter() - t0
+    COUNTERS.bump("host_syncs")
+    COUNTERS.bump("host_sync_s", time.perf_counter() - t0)
     return out
+
+
+#: When True, :func:`count_traces` wrappers do NOT bump ``jit_traces``:
+#: the program-ledger analysis path (train/reuse.py) re-lowers an
+#: already-traced program for cost/memory analysis, and that re-trace is
+#: bookkeeping, not a new compiled program on the training path — the
+#: reuse lane's zero-trace contract must not see it.
+_TRACE_COUNT_SUSPENDED = False
+
+
+@contextlib.contextmanager
+def suspend_trace_counting():
+    """Suppress ``jit_traces`` bumps inside the block (single-threaded
+    use only — the ledger analysis runs on the dispatching thread)."""
+    global _TRACE_COUNT_SUSPENDED
+    prev = _TRACE_COUNT_SUSPENDED
+    _TRACE_COUNT_SUSPENDED = True
+    try:
+        yield
+    finally:
+        _TRACE_COUNT_SUSPENDED = prev
 
 
 def count_traces(name: str, fn: Callable) -> Callable:
@@ -169,7 +224,8 @@ def count_traces(name: str, fn: Callable) -> Callable:
 
     @functools.wraps(fn)
     def traced(*args, **kwargs):
-        REUSE_COUNTERS.jit_traces += 1
+        if not _TRACE_COUNT_SUSPENDED:
+            COUNTERS.bump("jit_traces")
         return fn(*args, **kwargs)
 
     traced.__qualname__ = f"count_traces[{name}]"

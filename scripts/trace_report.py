@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Per-run telemetry rollup from a run directory alone — no re-running
+bench, no jax import (pure file reading, safe on any host).
+
+Reads the artifacts the unified telemetry layer
+(lfm_quant_tpu/utils/telemetry.py) writes when a run is active:
+
+* ``manifest.json``  — provenance (config, knobs, devices, git sha)
+* ``spans.jsonl``    — one line per closed span, with per-span counter
+                       deltas (``d``) and result args (``args``)
+* ``ledger.jsonl``   — program ledger: per-compiled-program compile
+                       wall seconds + XLA cost/memory analysis
+* ``trace.json``     — the Chrome-trace/Perfetto event stream (only
+                       its presence is reported here; load it at
+                       ui.perfetto.dev for the timeline)
+
+Prints epochs/hour, device-idle fraction, host-sync counts, the top
+spans by total wall time, and the HBM/compile-cost ledger by program.
+The epochs/hour and idle-fraction formulas match ``bench.py
+epoch_pipeline`` (epochs per fit-wall-hour; idle seconds over fit
+wall), so the rollup is directly cross-checkable against the bench
+ledger on comparable geometry.
+
+Usage:
+    python scripts/trace_report.py runs/c1_mlp_toy/wf
+    python scripts/trace_report.py runs/c1_mlp_toy/seed0 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+
+def _read_jsonl(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # a line truncated by a crash — skip, don't die
+    return out
+
+
+def load_run(run_dir: str) -> Dict[str, Any]:
+    """All telemetry artifacts of a run dir (missing ones → empty)."""
+    manifest: Optional[Dict[str, Any]] = None
+    mpath = os.path.join(run_dir, "manifest.json")
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            manifest = None
+    import glob
+
+    return {
+        "run_dir": run_dir,
+        "manifest": manifest,
+        "spans": _read_jsonl(os.path.join(run_dir, "spans.jsonl")),
+        "ledger": _read_jsonl(os.path.join(run_dir, "ledger.jsonl")),
+        # First process owns trace.json; later ones (backtest over a
+        # train dir) land as trace.<pid>.json — count them all.
+        "trace_files": sorted(
+            os.path.basename(p)
+            for p in glob.glob(os.path.join(run_dir, "trace*.json"))),
+    }
+
+
+def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
+    """Roll the raw artifacts up into the printed/JSON report dict."""
+    spans = run["spans"]
+    fits = [s for s in spans if s.get("name") == "fit"]
+    epochs = [s for s in spans if s.get("name") == "epoch"]
+    runs = [s for s in spans if s.get("name") == "run"]
+
+    fit_wall = sum(s.get("dur_s", 0.0) for s in fits)
+    n_epochs = sum(int(s.get("args", {}).get("epochs_run", 0))
+                   for s in fits)
+    if n_epochs == 0:  # fit spans absent/foreign — fall back to counting
+        n_epochs = sum(1 for s in epochs
+                       if not s.get("args", {}).get("discarded"))
+    idle_s = sum(s.get("d", {}).get("device_idle_s", 0.0) for s in fits)
+    syncs = sum(s.get("d", {}).get("host_syncs", 0) for s in fits)
+    sync_s = sum(s.get("d", {}).get("host_sync_s", 0.0) for s in fits)
+
+    # Run-level counters: sum over run records (one per process that
+    # attached this run dir — train, then backtest, then resume, ...).
+    counters: Dict[str, Any] = defaultdict(float)
+    for r in runs:
+        for k, v in r.get("d", {}).items():
+            counters[k] += v
+    counters = {k: (int(v) if float(v).is_integer() else v)
+                for k, v in counters.items()}
+    run_wall = sum(r.get("dur_s", 0.0) for r in runs)
+
+    by_name: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        name = s.get("name", "?")
+        agg = by_name.setdefault(name, {"name": name, "count": 0,
+                                        "total_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] += s.get("dur_s", 0.0)
+    for agg in by_name.values():
+        agg["total_s"] = round(agg["total_s"], 4)
+        agg["mean_s"] = round(agg["total_s"] / max(agg["count"], 1), 5)
+        if run_wall > 0:
+            agg["pct_wall"] = round(100.0 * agg["total_s"] / run_wall, 1)
+    top_spans = sorted((a for a in by_name.values() if a["name"] != "run"),
+                       key=lambda a: -a["total_s"])[:top]
+
+    programs: Dict[str, Dict[str, Any]] = {}
+    for e in run["ledger"]:
+        name = e.get("program", "?")
+        agg = programs.setdefault(name, {"program": name, "builds": 0,
+                                         "compile_s": 0.0, "flops": 0.0,
+                                         "bytes_accessed": 0.0,
+                                         "hbm_bytes": 0, "arg_bytes": 0})
+        agg["builds"] += 1
+        agg["compile_s"] += e.get("compile_s", 0.0)
+        agg["flops"] += e.get("flops", 0.0)
+        agg["bytes_accessed"] += e.get("bytes_accessed", 0.0)
+        # hbm_bytes needs the opt-in deep analysis
+        # (LFM_TELEMETRY_ANALYSIS=1); arg_bytes is always recorded and
+        # serves as the resident-footprint proxy otherwise.
+        agg["hbm_bytes"] = max(agg["hbm_bytes"], e.get("hbm_bytes", 0))
+        agg["arg_bytes"] = max(agg["arg_bytes"], e.get("arg_bytes", 0))
+    for agg in programs.values():
+        agg["compile_s"] = round(agg["compile_s"], 3)
+    ledger_rows = sorted(
+        programs.values(),
+        key=lambda a: -(a["hbm_bytes"] or a["arg_bytes"] or 0))
+
+    report = {
+        "run_dir": run["run_dir"],
+        "has_trace_json": bool(run["trace_files"]),
+        "trace_files": run["trace_files"],
+        "n_processes": len(runs),
+        "wall_s": round(run_wall, 3),
+        "n_fits": len(fits),
+        "n_epochs": n_epochs,
+        "fit_wall_s": round(fit_wall, 3),
+        "epochs_per_hour": (round(3600.0 * n_epochs / fit_wall, 1)
+                            if fit_wall > 0 else None),
+        "idle_frac": (round(idle_s / fit_wall, 4) if fit_wall > 0
+                      else None),
+        "host_syncs": int(syncs),
+        "host_sync_s": round(sync_s, 4),
+        "syncs_per_epoch": (round(syncs / n_epochs, 3) if n_epochs
+                            else None),
+        "counters": {k: (round(v, 4) if isinstance(v, float) else v)
+                     for k, v in sorted(counters.items())},
+        "compile_s_total": round(sum(e.get("compile_s", 0.0)
+                                     for e in run["ledger"]), 3),
+        "top_spans": top_spans,
+        "programs": ledger_rows,
+    }
+    m = run["manifest"]
+    if m:
+        jx = m.get("jax") if isinstance(m.get("jax"), dict) else {}
+        report["manifest"] = {
+            "ts": m.get("ts"),
+            "entry": m.get("entry"),
+            "git_sha": (m.get("git_sha") or "")[:12] or None,
+            "backend": jx.get("backend"),
+            "devices": jx.get("device_count"),
+            "jax": jx.get("jax_version"),
+            "config_name": (m.get("config") or {}).get("name")
+            if isinstance(m.get("config"), dict) else None,
+            "knobs": m.get("knobs"),
+        }
+    return report
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:,.1f} GiB"
+
+
+def print_report(rep: Dict[str, Any]) -> None:
+    print(f"run dir     : {rep['run_dir']}")
+    m = rep.get("manifest")
+    if m:
+        print(f"manifest    : {m.get('config_name') or '?'}  "
+              f"entry={m.get('entry')}  backend={m.get('backend')}"
+              f"×{m.get('devices')}  jax={m.get('jax')}  "
+              f"git={m.get('git_sha')}  at {m.get('ts')}")
+        knobs = m.get("knobs") or {}
+        on = [k for k, v in knobs.items() if v]
+        off = [k for k, v in knobs.items() if v is False]
+        print(f"knobs       : on={','.join(on) or '-'}  "
+              f"off={','.join(off) or '-'}")
+    tf = rep.get("trace_files") or []
+    print(f"trace files : "
+          f"{', '.join(tf) + ' (load at ui.perfetto.dev)' if tf else 'MISSING (run still in flight or crashed?)'}")
+    print(f"wall        : {rep['wall_s']:.1f}s over "
+          f"{rep['n_processes']} process(es); "
+          f"{rep['n_fits']} fit(s), {rep['n_epochs']} epochs")
+    eph = rep["epochs_per_hour"]
+    print(f"throughput  : "
+          f"{eph:,.1f} epochs/hour" if eph is not None else
+          "throughput  : n/a (no fit spans)")
+    if rep["idle_frac"] is not None:
+        print(f"device idle : {100.0 * rep['idle_frac']:.1f}% of fit wall")
+    print(f"host syncs  : {rep['host_syncs']} "
+          f"({rep['syncs_per_epoch']}/epoch, {rep['host_sync_s']:.3f}s "
+          f"blocked)" if rep["syncs_per_epoch"] is not None else
+          f"host syncs  : {rep['host_syncs']}")
+    c = rep["counters"]
+    print(f"counters    : jit_traces={c.get('jit_traces', 0)}  "
+          f"panel_transfers={c.get('panel_transfers', 0)}  "
+          f"program_builds={c.get('program_builds', 0)}  "
+          f"compile_s={rep['compile_s_total']}")
+    if rep["top_spans"]:
+        print("\ntop spans (by total wall):")
+        for a in rep["top_spans"]:
+            pct = f"{a.get('pct_wall', 0):5.1f}%" if "pct_wall" in a else ""
+            print(f"  {a['name']:<14} ×{a['count']:<5} "
+                  f"{a['total_s']:>9.3f}s  mean {a['mean_s']:.4f}s  {pct}")
+    if rep["programs"]:
+        print("\nprogram ledger (compile cost + HBM by program; "
+              "'args' = input-footprint proxy, set "
+              "LFM_TELEMETRY_ANALYSIS=1 for the full HBM analysis):")
+        for a in rep["programs"]:
+            flops = f"{a['flops']:,.0f} flops" if a["flops"] else ""
+            mem = (f"hbm {_fmt_bytes(a['hbm_bytes']):>12}"
+                   if a["hbm_bytes"] else
+                   f"args {_fmt_bytes(a['arg_bytes']):>11}")
+            print(f"  {a['program']:<18} builds={a['builds']:<3} "
+                  f"compile {a['compile_s']:>7.3f}s  {mem}  {flops}")
+    else:
+        print("\nprogram ledger: empty (telemetry run was not active "
+              "during compilation, or analysis disabled)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("run_dir", help="run directory written by train.py / "
+                                    "backtest.py with telemetry on")
+    ap.add_argument("--top", type=int, default=12,
+                    help="how many span rows to print (default 12)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report JSON instead")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        ap.error(f"not a directory: {args.run_dir}")
+    run = load_run(args.run_dir)
+    if not run["spans"] and not run["ledger"] and run["manifest"] is None:
+        ap.error(f"no telemetry artifacts under {args.run_dir} "
+                 "(was the run made with LFM_TELEMETRY on?)")
+    rep = build_report(run, top=args.top)
+    try:
+        if args.json:
+            print(json.dumps(rep, indent=2, default=str))
+        else:
+            print_report(rep)
+    except BrokenPipeError:  # `trace_report ... | head` is fine
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,293 @@
+"""Unified run telemetry (utils/telemetry.py): spans, counters, ledger.
+
+The telemetry layer's contract is that it OBSERVES the training path
+without perturbing it: spans nest and order correctly with per-span
+counter deltas, the emitted Chrome-trace JSON is valid (Perfetto-
+loadable), the program ledger records compile costs, trace_report rolls
+a run dir up from files alone — and the disabled path (LFM_TELEMETRY=0,
+or simply no active run) emits zero spans while the training loop's
+measured sync/trace counts stay IDENTICAL to the instrumented run
+(telemetry must never add a device round-trip; the reuse/pipeline
+lanes' zero-trace / one-sync-per-epoch contracts hold in both knob
+states). All tests carry the ``telemetry`` marker — the fast CI lane
+(``pytest -m telemetry``)."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import warnings
+
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.utils import telemetry
+from lfm_quant_tpu.utils.logging import MetricsLogger
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS, StepTimer
+
+pytestmark = pytest.mark.telemetry
+
+
+def _cfg(tmp, epochs=2):
+    return RunConfig(
+        name="tele",
+        data=DataConfig(n_firms=100, n_months=200, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=epochs, warmup_steps=5,
+                          loss="mse", early_stop_patience=99),
+        seed=0,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=100, n_months=200, n_features=5, seed=5)
+
+
+@pytest.fixture(scope="module")
+def splits(panel):
+    return PanelSplits.by_date(panel, 198001, 198201)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_run(monkeypatch):
+    """Telemetry activation is process-global; tests must not leak it.
+    The knob is pinned ON here so this lane tests what it claims even
+    under an outer LFM_TELEMETRY=0 (tests of the disabled path set the
+    env themselves, which overrides this default)."""
+    monkeypatch.setenv("LFM_TELEMETRY", "1")
+    assert telemetry._ACTIVE is None
+    yield
+    if telemetry._ACTIVE is not None:  # a failed test left a run open
+        telemetry._ACTIVE.finish()
+
+
+def _spans(run_dir):
+    with open(os.path.join(run_dir, "spans.jsonl")) as fh:
+        return [json.loads(line) for line in fh]
+
+
+# ---- span tracer ---------------------------------------------------------
+
+
+def test_span_nesting_ordering_and_deltas(tmp_path):
+    """Nested spans carry parent/depth; the jsonl stream is in CLOSING
+    order; counter bumps inside a child are attributed to the child AND
+    every enclosing span, and to no sibling."""
+    with telemetry.run_scope(str(tmp_path)):
+        with telemetry.span("outer", cat="test") as sp:
+            with telemetry.span("child"):
+                telemetry.COUNTERS.bump("tele_test_counter", 3)
+            with telemetry.span("sibling"):
+                pass
+            sp.set(result="done")
+    recs = {r["name"]: r for r in _spans(str(tmp_path))}
+    assert list(r["name"] for r in _spans(str(tmp_path))) == [
+        "child", "sibling", "outer", "run"]  # closing order, run last
+    assert recs["child"]["parent"] == "outer"
+    assert recs["child"]["depth"] == 1
+    assert recs["outer"]["depth"] == 0
+    assert recs["child"]["d"]["tele_test_counter"] == 3
+    assert recs["outer"]["d"]["tele_test_counter"] == 3  # hierarchical
+    assert "d" not in recs["sibling"] or \
+        "tele_test_counter" not in recs["sibling"].get("d", {})
+    assert recs["outer"]["args"]["result"] == "done"
+    # Durations nest: the child fits inside the parent.
+    assert recs["child"]["dur_s"] <= recs["outer"]["dur_s"]
+
+
+def test_chrome_trace_is_valid_and_async_spans_pair(tmp_path):
+    """trace.json is strict JSON in Chrome trace-event format: every
+    event has name/ph/ts/pid/tid, "X" events carry dur, and async
+    ("b"/"e") pairs share name+id — what Perfetto needs to render the
+    pipeline's overlapping epochs."""
+    with telemetry.run_scope(str(tmp_path)):
+        with telemetry.span("work", cat="test", bad=float("nan")):
+            h0 = telemetry.begin_async("epoch", epoch=0)
+            h1 = telemetry.begin_async("epoch", epoch=1)  # overlapping
+            h0.end()
+            h1.end(stop=True, val_ic=float("inf"))  # non-finite args
+        telemetry.instant("marker", note="hi")
+    raw = open(os.path.join(str(tmp_path), "trace.json")).read()
+    trace = json.loads(raw)  # strict JSON — json.loads rejects NaN? no,
+    assert "NaN" not in raw and "Infinity" not in raw
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    for e in events:
+        assert {"name", "ph", "pid"} <= set(e), e
+        if e["ph"] in ("X", "b", "e", "i"):
+            assert "ts" in e and "tid" in e, e
+        if e["ph"] == "X":
+            assert "dur" in e and e["dur"] >= 0, e
+    begins = [e for e in events if e["ph"] == "b"]
+    ends = [e for e in events if e["ph"] == "e"]
+    assert len(begins) == len(ends) == 2
+    assert ({(e["name"], e["id"]) for e in begins}
+            == {(e["name"], e["id"]) for e in ends})
+    assert len({e["id"] for e in begins}) == 2  # distinct overlap ids
+    assert any(e["ph"] == "i" for e in events)
+
+
+def test_disabled_knob_emits_nothing(tmp_path, monkeypatch):
+    """LFM_TELEMETRY=0: run_scope is a no-op — no manifest, no spans, no
+    trace — and span() returns the shared null span."""
+    monkeypatch.setenv("LFM_TELEMETRY", "0")
+    with telemetry.run_scope(str(tmp_path / "off")) as run:
+        assert run is None
+        s = telemetry.span("x")
+        assert s is telemetry._NULL
+        with s:
+            s.set(a=1)
+    assert not (tmp_path / "off").exists()
+
+
+def test_no_active_run_emits_nothing(tmp_path):
+    """Default-on telemetry WITHOUT an attached run dir (the library
+    path every test/bench run takes): spans are null, zero files."""
+    assert telemetry.active_run() is None
+    assert telemetry.span("x") is telemetry._NULL
+    assert telemetry.begin_async("x") is telemetry._NULL
+
+
+def test_telemetry_adds_no_syncs_or_traces_to_training(splits, tmp_path,
+                                                       monkeypatch):
+    """The acceptance contract, measured: a fit with telemetry ACTIVE
+    (spans + ledger + analysis) pays exactly the same counted host
+    syncs per epoch and the same warm-path jit traces as a fit with no
+    run attached and one with LFM_TELEMETRY=0 — the layer observes the
+    loop, never adds a device round-trip. (Analysis re-lowering runs
+    under suspend_trace_counting, so even COLD trace counts match.)"""
+    from lfm_quant_tpu.train.loop import Trainer
+
+    # Warm the shared program cache first so every measured pass binds
+    # the same executables — the comparison is then pure telemetry
+    # overhead, not cold-compile ordering.
+    Trainer(_cfg(tmp_path, epochs=2), splits, run_dir=None).fit()
+    results = {}
+    for label, env, attach in (("active", "1", True),
+                               ("inactive", "1", False),
+                               ("off", "0", False)):
+        monkeypatch.setenv("LFM_TELEMETRY", env)
+        snap = REUSE_COUNTERS.snapshot()
+        scope = (telemetry.run_scope(str(tmp_path / label)) if attach
+                 else telemetry.run_scope(None))
+        with scope:
+            t = Trainer(_cfg(tmp_path, epochs=2), splits, run_dir=None)
+            s = t.fit()
+        d = REUSE_COUNTERS.delta(snap)
+        results[label] = (s["epochs_run"], d["host_syncs"],
+                          d["jit_traces"])
+    # Programs are warm after the first pass (shared program cache), so
+    # all three must agree: one sync per epoch, zero extra traces.
+    assert results["active"] == results["inactive"] == results["off"]
+    assert results["active"][1] == results["active"][0]  # syncs == epochs
+
+
+def test_run_manifest_contents(tmp_path):
+    cfg = _cfg(tmp_path)
+    with telemetry.run_scope(str(tmp_path), cfg, extra={"entry": "test"}):
+        pass
+    m = json.load(open(os.path.join(str(tmp_path), "manifest.json")))
+    assert m["entry"] == "test"
+    assert m["config"]["name"] == "tele"
+    assert m["jax"]["jax_version"]
+    assert m["jax"]["device_count"] >= 1
+    assert isinstance(m["env_lfm"], dict)
+    assert m["knobs"]["telemetry"] is True
+    assert "async_pipeline" in m["knobs"]
+
+
+def test_program_ledger_and_trace_report_cli(splits, tmp_path):
+    """End to end: a fit under an active run writes spans.jsonl +
+    ledger.jsonl + trace.json; the trace_report CLI rolls them up from
+    the run dir alone with epochs/hour and idle fraction computed by
+    the same formulas bench.py epoch_pipeline uses."""
+    from lfm_quant_tpu.train import reuse
+    from lfm_quant_tpu.train.loop import Trainer
+
+    reuse.clear_program_cache()  # cold programs → ledger entries
+    run_dir = str(tmp_path / "run")
+    with telemetry.run_scope(run_dir, _cfg(tmp_path)):
+        t = Trainer(_cfg(tmp_path, epochs=3), splits, run_dir=None)
+        summary = t.fit()
+    led = [json.loads(line)
+           for line in open(os.path.join(run_dir, "ledger.jsonl"))]
+    assert {e["program"] for e in led} >= {"multi_step", "forward"}
+    assert all(e["compile_s"] > 0 for e in led)
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.getcwd(), "scripts",
+                                      "trace_report.py"), run_dir,
+         "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout)
+    assert rep["n_fits"] == 1
+    assert rep["n_epochs"] == summary["epochs_run"] == 3
+    assert rep["epochs_per_hour"] > 0
+    assert rep["idle_frac"] is not None
+    assert rep["host_syncs"] == 3 and rep["syncs_per_epoch"] == 1.0
+    assert rep["compile_s_total"] > 0
+    assert any(p["program"] == "multi_step" for p in rep["programs"])
+    assert rep["has_trace_json"]
+    # The rollup's epochs/hour is the bench formula on the fit span.
+    fit = [r for r in _spans(run_dir) if r["name"] == "fit"][0]
+    assert rep["epochs_per_hour"] == pytest.approx(
+        3600.0 * 3 / fit["dur_s"], rel=0.01)
+
+
+# ---- satellite regressions ----------------------------------------------
+
+
+def test_metrics_logger_nonfinite_floats_stay_valid_json(tmp_path):
+    """json.dumps(float('nan')) emits a bare NaN token — invalid JSON
+    that would corrupt the metrics.jsonl line crash-resume reads. The
+    logger must serialize non-finite values as null (and keep the
+    in-memory record's real floats)."""
+    with MetricsLogger(str(tmp_path)) as log:
+        rec = log.log(1, val_ic=float("nan"), loss=float("inf"),
+                      ok=1.5, neg=float("-inf"),
+                      per_seed=[0.1, float("nan")],  # nested containers
+                      nested={"a": float("inf"), "b": 2.0})
+    assert math.isnan(rec["val_ic"])  # caller's record untouched
+    line = open(os.path.join(str(tmp_path), "metrics.jsonl")).read()
+    assert "NaN" not in line and "Infinity" not in line
+    parsed = json.loads(line)  # strict-parses
+    assert parsed["val_ic"] is None
+    assert parsed["loss"] is None
+    assert parsed["neg"] is None
+    assert parsed["ok"] == 1.5
+    assert parsed["per_seed"] == [0.1, None]
+    assert parsed["nested"] == {"a": None, "b": 2.0}
+
+
+def test_steptimer_stop_without_start_warns_not_raises():
+    t = StepTimer()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        dt = t.stop(firm_months=10.0)
+    assert dt == 0.0
+    assert t.steps == 0 and t.seconds == 0.0 and t.firm_months == 0.0
+    assert any("start()" in str(x.message) for x in w)
+    # A proper start/stop afterwards still works.
+    t.start()
+    assert t.stop(firm_months=1.0) >= 0.0
+    assert t.steps == 1
+
+
+def test_reuse_counters_view_and_float_fields():
+    """ReuseCounters is a view over telemetry.COUNTERS: bumps through
+    either surface agree, and the float fields (host_sync_s,
+    device_idle_s) round-trip as floats through snapshot/delta."""
+    snap = REUSE_COUNTERS.snapshot()
+    REUSE_COUNTERS.jit_traces += 1
+    telemetry.COUNTERS.bump("host_sync_s", 0.25)
+    d = REUSE_COUNTERS.delta(snap)
+    assert d["jit_traces"] == 1
+    assert isinstance(d["host_sync_s"], float)
+    assert d["host_sync_s"] == pytest.approx(0.25)
+    assert telemetry.COUNTERS.get("jit_traces") == snap["jit_traces"] + 1

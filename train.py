@@ -137,27 +137,39 @@ def main(argv=None) -> int:
         ))
 
     import contextlib
+    import os
 
-    from lfm_quant_tpu.utils import sanitized, trace_context
+    from lfm_quant_tpu.utils import sanitized, telemetry, trace_context
     from lfm_quant_tpu.utils.distributed import maybe_initialize
 
     maybe_initialize()  # multi-host pods; no-op on a single host
+
+    # The run dir each branch will write into — known up front so the
+    # telemetry run scope (manifest.json at start; spans.jsonl +
+    # trace.json + ledger.jsonl over the run) covers the whole run.
+    # LFM_TELEMETRY=0 makes the scope a no-op.
+    if args.walk_forward is not None:
+        run_dir = os.path.join(cfg.out_dir, cfg.name, "wf")
+    elif cfg.n_seeds > 1:
+        run_dir = os.path.join(cfg.out_dir, cfg.name, "ensemble")
+    else:
+        run_dir = os.path.join(cfg.out_dir, cfg.name, f"seed{cfg.seed}")
 
     ctx = contextlib.ExitStack()
     with ctx:
         if args.debug:
             ctx.enter_context(sanitized())
         ctx.enter_context(trace_context(args.profile))
+        ctx.enter_context(telemetry.run_scope(
+            run_dir, cfg, extra={"entry": "train"}))
         if args.walk_forward is not None:
-            import os
-
             from lfm_quant_tpu.train.loop import resolve_panel
             from lfm_quant_tpu.train.walkforward import run_walkforward
 
             panel = resolve_panel(cfg.data)
             start = args.wf_start or int(
                 panel.dates[int(panel.n_months * 0.6)])
-            wf_dir = os.path.join(cfg.out_dir, cfg.name, "wf")
+            wf_dir = run_dir
             _, _, summary = run_walkforward(
                 cfg, panel, start=start, step_months=args.walk_forward,
                 val_months=args.wf_val_months, n_folds=args.wf_folds,
