@@ -463,6 +463,112 @@ def bench_walkforward_reuse() -> None:
     _emit("walkforward_reuse", warm_rate, 0.0, **extras)
 
 
+def bench_walkforward_foldstack() -> None:
+    """walkforward_foldstack — the fold-vectorized walk-forward metric:
+    folds/hour with the whole sweep trained as ONE fold-stacked,
+    pipelined program (train/foldstack.py, LFM_FOLDSTACK) vs the
+    sequential per-fold fits, on the SAME fold set.
+
+    Both passes run warm (a throwaway pass per mode first pays tracing /
+    XLA compilation through the reuse caches), so the ratio prices
+    exactly what fold-stacking removes: F-1 sequential walks through the
+    per-epoch fixed costs — metric syncs (one per stacked epoch instead
+    of one per fold-epoch), host sampling windows, dispatch latency —
+    plus the mesh's idle fold axis. The stacked stitched forecasts are
+    checked against the sequential ones (max_abs_diff on the row): the
+    speedup must not come from computing something else. Toy MLP
+    geometry on purpose — the metric prices SWEEP STRUCTURE, not model
+    throughput (c2/c5 own that), which also makes the CPU fallback
+    meaningful when the tunnel is wedged.
+    """
+    import shutil
+    import tempfile
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.train.walkforward import run_walkforward
+
+    n_folds = int(os.environ.get("LFM_BENCH_WF_STACK_FOLDS", "4"))
+    n_epochs = int(os.environ.get("LFM_BENCH_WF_STACK_EPOCHS", "4"))
+    if n_folds < 2 or n_epochs < 1:
+        # Honor the operator's geometry; only the structural minimums
+        # are enforced, loudly (stacking needs >= 2 folds to mean
+        # anything, and a 0-epoch fit prices nothing).
+        print(f"[bench] walkforward_foldstack geometry clamped: "
+              f"folds {n_folds}->{max(2, n_folds)}, "
+              f"epochs {n_epochs}->{max(1, n_epochs)}",
+              file=sys.stderr, flush=True)
+        n_folds, n_epochs = max(2, n_folds), max(1, n_epochs)
+    cfg = RunConfig(
+        name="wf_foldstack_bench",
+        data=DataConfig(n_firms=100, n_months=240, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=n_epochs, warmup_steps=5,
+                          early_stop_patience=n_epochs + 1, loss="mse"),
+        seed=0,
+    )
+    panel = synthetic_panel(n_firms=100, n_months=240, n_features=5, seed=5)
+    rtt = dispatch_rtt_ms()
+    kw = dict(start=197801, step_months=12, val_months=24, n_folds=n_folds,
+              train_months=72)
+
+    def one(stacked: bool, out: str):
+        t0 = time.perf_counter()
+        fc, _, summary = run_walkforward(cfg, panel, out_dir=out,
+                                         foldstack=stacked, **kw)
+        return time.perf_counter() - t0, fc, summary
+
+    root = tempfile.mkdtemp(prefix="lfm_wf_foldstack_bench_")
+    try:
+        # Warmup passes compile both modes' programs (shared reuse
+        # caches); the timed passes then price the loop, not XLA.
+        one(False, os.path.join(root, "wseq"))
+        one(True, os.path.join(root, "wstk"))
+        t_seq, fc_seq, _ = one(False, os.path.join(root, "seq"))
+        t_stk, fc_stk, summary = one(True, os.path.join(root, "stk"))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    import numpy as np
+
+    stack = summary.get("foldstack") or {}
+    if not stack.get("enabled"):
+        # The stacked pass silently degraded to the sequential path
+        # (FoldstackUnavailable warning) — emitting would bank a
+        # seq-vs-seq row indistinguishable from a real unsharded-stack
+        # measurement. Fail through the bench_error record instead.
+        raise RuntimeError(
+            "fold-stacking degraded to the sequential path — no "
+            "walkforward_foldstack metric to record")
+    max_abs_diff = float(np.abs(fc_seq - fc_stk).max())
+    if max_abs_diff > 1e-4:
+        # The speedup must come from removing fixed costs, not from
+        # computing something else: the foldstack lane pins stacked
+        # forecasts to sequential within float32 reduction-order
+        # tolerance, and a row that fails that bound must not be banked
+        # as a performance number.
+        raise RuntimeError(
+            f"stacked forecasts diverged from sequential "
+            f"(max_abs_diff={max_abs_diff:g} > 1e-4) — parity broken, "
+            "row not recorded")
+    extras = {
+        "unit": "folds/hour",
+        "n_folds": n_folds,
+        "n_epochs": n_epochs,
+        "seq_folds_per_hour": round(3600.0 * n_folds / max(t_seq, 1e-9), 1),
+        "speedup": round(t_seq / max(t_stk, 1e-9), 2),
+        "seq_s": round(t_seq, 2),
+        "stack_s": round(t_stk, 2),
+        "fold_mesh": stack.get("fold_mesh"),
+        "max_abs_diff": max_abs_diff,
+    }
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("walkforward_foldstack", 3600.0 * n_folds / max(t_stk, 1e-9),
+          0.0, **extras)
+
+
 def _cpu_metric_fallback(flag: str, budget_s: float) -> bool:
     """Wedged-tunnel fallback for a backend-independent metric: the
     quantities walkforward_reuse (compiles/transfers per warm fold) and
@@ -1070,8 +1176,8 @@ def main() -> int:
             # can never turn the structured give-up into an os._exit.
             if (os.environ.get("LFM_BENCH_FAKE_WEDGE") != "1"
                     and probe.get("kind") == "tunnel_wedged"):
-                for flag in ("--walkforward-reuse", "--scoring-pipeline",
-                             "--epoch-pipeline"):
+                for flag in ("--walkforward-reuse", "--walkforward-foldstack",
+                             "--scoring-pipeline", "--epoch-pipeline"):
                     _cpu_metric_fallback(
                         flag,
                         deadline_s - (time.monotonic() - t_start) - 30.0)
@@ -1105,6 +1211,14 @@ def main() -> int:
             print(f"bench_walkforward_reuse failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
             _emit_status("bench_error", stage="walkforward_reuse",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
+        try:
+            bench_walkforward_foldstack()
+        except Exception as e:  # noqa: BLE001 — earlier rows must still reach the driver
+            print(f"bench_walkforward_foldstack failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            _emit_status("bench_error", stage="walkforward_foldstack",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
         try:
@@ -1154,6 +1268,9 @@ if __name__ == "__main__":
     if "--walkforward-reuse" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_walkforward_reuse,
                                      "walkforward_reuse"))
+    if "--walkforward-foldstack" in sys.argv[1:]:
+        sys.exit(_single_metric_main(bench_walkforward_foldstack,
+                                     "walkforward_foldstack"))
     if "--scoring-pipeline" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_scoring_pipeline,
                                      "scoring_pipeline"))

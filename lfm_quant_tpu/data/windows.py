@@ -136,10 +136,12 @@ class DateBatchSampler:
 
         ``engine``: "python" (numpy RNG, the determinism contract tests pin
         down), "native" (the C++ sampler in lfm_quant_tpu/native/ — its own
-        deterministic order keyed by (seed, epoch), ~30× faster epoch
-        generation (measured — ledger `native_host_runtime` rows), the
-        host-side win for many-seed ensembles), or "auto"
-        (native when built, else python)."""
+        deterministic order keyed by (seed, epoch), ~29× faster epoch
+        generation (median of the latest capture ± 34% within-capture
+        spread; cross-session range 13–52× — ledger
+        `native_host_runtime` epoch_sampling rows, per BASELINE.md's
+        error-bar protocol), the host-side win for many-seed ensembles),
+        or "auto" (native when built, else python)."""
         self.window = window
         self.dates_per_batch = dates_per_batch
         if firms_per_date < 0:
@@ -316,6 +318,12 @@ class DateBatchSampler:
             weight=np.stack([b.weight for b in batches]),
         )
 
+    def stacked_eval_months(self) -> int:
+        """Number of eval months :meth:`stacked_cross_sections` covers —
+        the fold-stacked walk-forward's shape-alignment probe (folds must
+        agree on it before their eval sweeps can stack)."""
+        return int(self._all_dates.size)
+
     def full_cross_sections(self) -> Iterator[WindowIndex]:
         """Deterministic sweep over every eligible (date, firm) pair, for
         eval/inference: each batch is one date's full cross-section padded
@@ -337,6 +345,35 @@ class DateBatchSampler:
                 time_idx=np.asarray([t], dtype=np.int32),
                 weight=weight,
             )
+
+
+def stack_fold_epochs(samplers, epoch: int) -> WindowIndex:
+    """One training epoch from EACH fold's sampler, stacked on a leading
+    fold axis: ``firm_idx [F, K, D, Bf]``, ``time_idx [F, K, D]``,
+    ``weight [F, K, D, Bf]`` — the fold-vectorized walk-forward's batch
+    supply (train/foldstack.py).
+
+    Per-fold PRNG streams are threaded untouched: entry k is EXACTLY the
+    index stack fold k's sequential run would sample for this epoch —
+    each sampler keeps its own fold seed and anchor range, and
+    ``stacked_epoch`` with an explicit epoch is a pure deterministic read
+    (prefetch-thread-safe, same contract as the async pipeline relies
+    on). Raises when folds disagree on steps-per-epoch: stacking requires
+    the same-shape schedule a rolling ``train_months`` window guarantees,
+    and a silent truncation would train some folds on partial epochs.
+    """
+    per = [s.stacked_epoch(epoch) for s in samplers]
+    ks = {b.firm_idx.shape[0] for b in per}
+    if len(ks) != 1:
+        raise ValueError(
+            f"fold-stacked epoch needs equal steps-per-epoch across "
+            f"folds, got {sorted(ks)} — use a rolling train_months "
+            "window (same-shape folds)")
+    return WindowIndex(
+        firm_idx=np.stack([b.firm_idx for b in per]),
+        time_idx=np.stack([b.time_idx for b in per]),
+        weight=np.stack([b.weight for b in per]),
+    )
 
 
 def resolve_gather_impl(impl: str, mesh, panel: Panel, window: int,

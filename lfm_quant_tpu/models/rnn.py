@@ -287,8 +287,14 @@ class RNNModel(nn.Module):
                 "auto-resolution routes factorized models to the XLA "
                 "scan; don't force a pallas impl on one)")
         compute_dtype = self.dtype or jnp.float32
-        block_b = (self.eval_scan_block_b or self.scan_block_b
-                   if deterministic else self.scan_block_b)
+        # Select on `is not None`, not truthiness: an EXPLICIT
+        # eval_scan_block_b=0 means "pin the kernel default for eval"
+        # independently of whatever scan_block_b the train step tuned —
+        # a falsy-`or` fallback would silently re-route eval through the
+        # train block size.
+        block_b = (self.eval_scan_block_b
+                   if deterministic and self.eval_scan_block_b is not None
+                   else self.scan_block_b)
         batch_shape = x.shape[:-2]
         h = nn.Dense(self.hidden, dtype=self.dtype, name="embed")(
             x.astype(compute_dtype)

@@ -3,9 +3,11 @@ sequence/context parallelism (ring attention over a 'seq' axis)."""
 
 from lfm_quant_tpu.parallel.mesh import (
     DATA_AXIS,
+    FOLD_AXIS,
     SEED_AXIS,
     SEQ_AXIS,
     batch_sharding,
+    make_fold_mesh,
     make_mesh,
     mesh_fingerprint,
     replicated,
@@ -24,7 +26,9 @@ __all__ = [
     "SEED_AXIS",
     "DATA_AXIS",
     "SEQ_AXIS",
+    "FOLD_AXIS",
     "make_mesh",
+    "make_fold_mesh",
     "mesh_fingerprint",
     "replicated",
     "batch_sharding",

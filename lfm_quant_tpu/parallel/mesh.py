@@ -35,6 +35,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 SEED_AXIS = "seed"
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"  # matches parallel/ring.py's axis name
+# Fold-stacked walk-forward (train/foldstack.py): independent same-shape
+# folds stacked on a leading axis of one program — the OUTERMOST mesh
+# axis because, like 'seed', folds exchange no traffic (no per-step
+# collective ever crosses it).
+FOLD_AXIS = "fold"
 
 
 def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
@@ -99,6 +104,67 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
     if n_seq > 1:
         return Mesh(grid, (SEED_AXIS, DATA_AXIS, SEQ_AXIS))
     return Mesh(grid.reshape(n_seed, n_data), (SEED_AXIS, DATA_AXIS))
+
+
+def make_fold_mesh(fold_count: int, inner_mesh: Optional[Mesh] = None,
+                   max_fold: Optional[int] = None) -> Optional[Mesh]:
+    """Mesh for the fold-stacked walk-forward: a leading 'fold' axis
+    composed OUTSIDE the trainer's existing seed/data axes.
+
+    The fold axis takes the largest divisor of ``fold_count`` that fits
+    the devices left after the inner mesh's axes (folds are independent,
+    so any divisor is legal — a non-divisor would leave ragged shards).
+    ``inner_mesh`` is the trainer's own mesh: its seed/data axis SIZES
+    are preserved so the inner step/eval programs' collectives (psum over
+    'data'/'seed') bind unchanged inside the fold shard_map. Returns
+    ``None`` when no sharding applies (single device, no inner axes, and
+    no divisor > 1) — the caller then runs the pure-vmap fold stack.
+    ``max_fold`` caps the fold axis (the ``LFM_FOLDSTACK_SHARDS`` knob;
+    0 forces the fold axis to 1).
+
+    A seq axis is NOT composed: sequence parallelism's ring collectives
+    assume the window shards are the innermost ICI neighbors, which a
+    fold axis would interleave — callers degrade to the sequential
+    walk-forward instead (train/foldstack.py).
+    """
+    inner_shape = dict(inner_mesh.shape) if inner_mesh is not None else {}
+    if inner_shape.get(SEQ_AXIS, 1) > 1:
+        raise ValueError("fold mesh cannot compose with a live seq axis")
+    inner_shape.pop(SEQ_AXIS, None)
+    inner_n = 1
+    for v in inner_shape.values():
+        inner_n *= v
+    budget = max(1, len(jax.devices()) // inner_n)
+    if max_fold is not None:
+        budget = min(budget, max(1, max_fold)) if max_fold > 0 else 1
+    n_fold = 1
+    for cand in range(min(fold_count, budget), 1, -1):
+        if fold_count % cand == 0:
+            n_fold = cand
+            break
+    if n_fold == 1 and not inner_shape:
+        return None  # nothing to shard — pure vmap over the fold axis
+    axes, sizes = [FOLD_AXIS], [n_fold]
+    for name in (SEED_AXIS, DATA_AXIS):
+        if name in inner_shape:
+            axes.append(name)
+            sizes.append(inner_shape[name])
+    need = int(np.prod(sizes))
+    # Preserve the inner mesh's topology-aware placement (make_mesh puts
+    # the 'data' psum axis on ICI-adjacent devices and keeps 'seed'
+    # across DCN): the inner devices lead the grid IN THEIR MESH ORDER,
+    # so with fold=1 the fold mesh is exactly the inner placement plus a
+    # leading axis; extra fold blocks fill from the remaining devices in
+    # positional order (best effort — folds themselves are traffic-free).
+    if inner_mesh is not None:
+        inner_devs = list(inner_mesh.devices.flat)
+        inner_ids = {d.id for d in inner_devs}
+        devs = inner_devs + [d for d in jax.devices()
+                             if d.id not in inner_ids]
+    else:
+        devs = jax.devices()
+    grid = np.asarray(devs[:need]).reshape(sizes)
+    return Mesh(grid, tuple(axes))
 
 
 def shard_map_compat(fn, *, mesh: Mesh, in_specs, out_specs,

@@ -16,6 +16,22 @@ import orbax.checkpoint as ocp
 from lfm_quant_tpu.utils import telemetry
 
 
+def fold_slice(state_dict: Any, idx: int) -> Any:
+    """Per-fold slice of a fold-stacked train-state pytree (leading fold
+    axis on every array leaf) — the checkpoint UNSTACKING the
+    fold-vectorized walk-forward (train/foldstack.py) uses to write each
+    fold's ``ckpt/best`` line out of the stacked fit's device-side best
+    params, so every fold run dir stays loadable by the exact same
+    ``load_trainer``/``load_ensemble`` path a sequential sweep feeds.
+    Leaves come back as ndarrays (never numpy SCALARS — indexing a 1-d
+    leaf like the optimizer step count would otherwise yield np.int32,
+    which Orbax's StandardSave rejects)."""
+    import jax
+    import numpy as np
+
+    return jax.tree.map(lambda x: np.asarray(x[idx]), state_dict)
+
+
 class CheckpointManager:
     """Thin wrapper over ocp.CheckpointManager for train-state pytrees.
 

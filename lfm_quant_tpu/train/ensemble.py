@@ -334,6 +334,23 @@ class EnsembleTrainer:
         state = jax.vmap(self.inner.init_state)(keys)
         return self._commit_state(state)
 
+    def init_stacked_states(self, seeds) -> TrainState:
+        """[F, S]-stacked fresh ensemble TrainStates for the
+        fold-vectorized walk-forward (train/foldstack.py): fold k's seed
+        block is bit-identical to ``init_state()`` under
+        ``cfg.seed = seeds[k]`` — the same root-key split into
+        ``n_seeds`` member keys, vmapped twice (members inside, folds
+        outside). Left UNCOMMITTED: the fold-stack driver places the
+        stacked state on its own fold mesh."""
+        import jax.numpy as jnp
+
+        def one_fold(seed):
+            keys = jax.random.split(jax.random.key(seed), self.n_seeds)
+            return jax.vmap(self.inner.init_state)(keys)
+
+        return jax.vmap(one_fold)(
+            jnp.asarray(list(seeds), dtype=jnp.uint32))
+
     def _commit_state(self, state: TrainState) -> TrainState:
         """Place a stacked state on the mesh (seed axis sharded). Needed
         after Orbax restores, whose arrays arrive committed to one device

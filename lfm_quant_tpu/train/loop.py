@@ -18,8 +18,8 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import (Any, Callable, Dict, NamedTuple, Optional, Tuple,
-                    Union)
+from typing import (Any, Callable, Dict, NamedTuple, Optional, Sequence,
+                    Tuple, Union)
 
 import jax
 import jax.numpy as jnp
@@ -1009,6 +1009,19 @@ class Trainer:
         state_rng = jax.random.key_data(jax.random.fold_in(rng, 0x0D0))
         return TrainState(params, self.tx.init(params), jnp.asarray(0),
                           state_rng)
+
+    def init_stacked_states(self, seeds: Sequence[int]) -> TrainState:
+        """[F]-stacked fresh TrainStates, one independent draw per seed —
+        the fold-vectorized walk-forward's init (train/foldstack.py).
+        Entry k is bit-identical to what ``init_state()`` produces under
+        ``cfg.seed = seeds[k]``: the same ``jax.random.key(seed)`` root,
+        the same derived dropout key, the same vmapped optimizer-state
+        tree the jitted step's structure contract relies on — so a
+        stacked fold starts from exactly the parameters its sequential
+        run would."""
+        keys = jax.vmap(jax.random.key)(
+            jnp.asarray(list(seeds), dtype=jnp.uint32))
+        return jax.vmap(self.init_state)(keys)
 
     def _batch_args(self, b: WindowIndex, train: bool = False,
                     steps: bool = False):

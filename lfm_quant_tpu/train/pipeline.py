@@ -164,7 +164,18 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
                    checkpointing: bool) -> Tuple[Any, Optional[int]]:
     """Drive a fit's epoch loop — lock-step or pipelined (``LFM_ASYNC``).
 
-    Callback contract (shared by Trainer and EnsembleTrainer):
+    ``harness`` is duck-typed: the driver consumes only ``epochs``,
+    ``next_epoch()`` and ``end_epoch(epoch, step, state_dict, val_ic) ->
+    stop`` — ``FitHarness`` for the sequential trainers, the fold-stack
+    driver's thin shell (train/foldstack.py ``_StackHarness``) when
+    early stopping lives device-side and the stop flag is derived by
+    ``finish`` from the fetched per-fold live mask. ``state`` is equally
+    opaque: any pytree consumed linearly by ``dispatch`` works (the
+    fold-stacked path threads a (TrainState, best_params, ctrl) carry);
+    async-mode snapshots/rollbacks ``jax.tree.map`` over it wholesale.
+
+    Callback contract (shared by Trainer, EnsembleTrainer and the
+    fold-stack driver):
 
     * ``build(epoch) -> (batches, firm_months)`` — host sampling + H2D
       staging; MUST be thread-safe for explicit epochs (runs on the

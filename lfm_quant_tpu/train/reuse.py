@@ -115,6 +115,44 @@ def async_ckpt_enabled() -> bool:
     return os.environ.get("LFM_ASYNC_CKPT", "1") != "0"
 
 
+def foldstack_enabled() -> bool:
+    """Fold-stacked walk-forward mode switch: ``LFM_FOLDSTACK=1`` makes
+    ``run_walkforward`` train all same-shape folds as ONE stacked,
+    fold-sharded program (train/foldstack.py) instead of F sequential
+    fits. Default OFF — unlike the other fast-path knobs — because the
+    mode trades per-epoch crash-resume durability for throughput (fold
+    checkpoints are unstacked at finalize, not written per epoch) and
+    requires the rolling ``train_months`` schedule; the ``--wf-foldstack``
+    CLI flag and the ``foldstack=`` argument opt in explicitly."""
+    return os.environ.get("LFM_FOLDSTACK", "0") not in ("0", "")
+
+
+def foldstack_shards() -> Optional[int]:
+    """``LFM_FOLDSTACK_SHARDS``: cap on the fold mesh axis. Unset/"auto"
+    = largest divisor of the fold count that fits the devices left by
+    the trainer's own seed/data axes; ``0`` pins the fold axis to 1
+    (pure-vmap stacking — the sharding A/B switch); ``N`` caps it."""
+    v = os.environ.get("LFM_FOLDSTACK_SHARDS")
+    if v in (None, "", "auto"):
+        return None
+    return max(0, int(v))
+
+
+def foldstack_program_key(inner_key: Tuple, mesh, fold_count: int,
+                          patience: int) -> Tuple:
+    """Cache key for the fold-stacked epoch program: the inner trainer/
+    ensemble bundle's key (already backend/mesh/donation-qualified) plus
+    the fold-stack geometry — fold count and fold-mesh placement change
+    the traced program's shapes/collectives, and the early-stop
+    ``patience`` is baked into the device-side control update as a
+    constant (the sequential path keeps it host-side, so only this key
+    needs it)."""
+    from lfm_quant_tpu.parallel.mesh import mesh_fingerprint
+
+    return ("foldstack", inner_key, mesh_fingerprint(mesh), fold_count,
+            patience)
+
+
 def multi_step_donate_argnums() -> Tuple[int, ...]:
     """``donate_argnums`` for the jitted MULTI-step wrappers: the
     TrainState argument (position 0) is donated so XLA aliases the

@@ -148,6 +148,30 @@ def score_stitched(forecast: np.ndarray, valid: np.ndarray, panel: Panel,
     return {label: _report_scalars(rep) for label, rep in reports.items()}
 
 
+def write_fold_run_dir(fold_cfg: RunConfig, run_dir: str, train_end: int,
+                       val_end: int, train_start: Optional[int],
+                       ensemble: bool) -> None:
+    """Make a fold dir a standalone loadable run dir
+    (``load_trainer``/``load_ensemble``): config.json pins the FOLD's
+    split boundaries so a reload reconstructs the exact training-time
+    splits, and the ensemble marker routes ``load_forecaster``. Written
+    BEFORE fit so a crashed fold is still inspectable (``forecast.py``
+    uses the LAST fold — the model trained on the most recent data — for
+    live rankings). Shared by the sequential and fold-stacked paths."""
+    from lfm_quant_tpu.train.forecast import mark_ensemble_run_dir
+
+    os.makedirs(run_dir, exist_ok=True)
+    save_cfg = dataclasses.replace(
+        fold_cfg, data=dataclasses.replace(
+            fold_cfg.data, train_end=train_end, val_end=val_end,
+            train_start=train_start))
+    with open(os.path.join(run_dir, "config.json"), "w") as fh:
+        fh.write(save_cfg.to_json())
+    # Also CLEARS a stale flag when a reused dir flips trainer kind
+    # between runs.
+    mark_ensemble_run_dir(run_dir, ensemble)
+
+
 def _load_fold_best_params(trainer, fold_dir: str):
     """Best params of a previously-completed fold, restored from its
     ``ckpt/best`` line — the warm-start carry for folds whose in-memory
@@ -186,7 +210,8 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                     warm_start: bool = False,
                     train_months: Optional[int] = None,
                     score_modes: Optional[Sequence] = None,
-                    score_kwargs: Optional[Dict[str, Any]] = None
+                    score_kwargs: Optional[Dict[str, Any]] = None,
+                    foldstack: Optional[bool] = None
                     ) -> Tuple[np.ndarray, np.ndarray, Dict[str, Any]]:
     """Train a model (or seed ensemble, ``cfg.n_seeds > 1``) per fold and
     stitch the out-of-sample forecasts.
@@ -252,6 +277,21 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
     (``jit_traces``, ``panel_transfers``, cache hit/miss counts — see
     utils/profiling.py ReuseCounters), and on a same-shape schedule every
     fold after the first reports zero for both.
+
+    ``foldstack``: train ALL same-shape folds as ONE stacked, fold-
+    sharded, pipelined program (train/foldstack.py) instead of F
+    sequential fits — None defers to the ``LFM_FOLDSTACK`` env knob
+    (default off). Needs the rolling ``train_months`` window; per-fold
+    histories, best epochs, early-stop epochs and restored best params
+    match sequential execution (the ``foldstack`` test lane's contract).
+    Incompatible with ``resume``/``warm_start`` (the stacked fit writes
+    fold checkpoints only at finalize, and the warm-start carry is
+    inherently serial) — those raise rather than silently degrade. A
+    data-dependent shape mismatch (ragged fold schedules) falls back to
+    the sequential path with a warning. When stacked, each fold record
+    carries ``"foldstack": True`` and its ``reuse`` delta covers the
+    fold's UNSTACK phase (checkpoint write + predict); the whole stacked
+    fit's compile/transfer delta lands in ``summary["foldstack"]``.
     """
     from lfm_quant_tpu.train.ensemble import EnsembleTrainer
     from lfm_quant_tpu.train.loop import Trainer
@@ -305,9 +345,57 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                 raise ValueError("resume snapshot shape mismatch "
                                  f"{forecast.shape} — n_seeds changed?")
 
+    from lfm_quant_tpu.train import reuse
+
+    use_stack = (foldstack if foldstack is not None
+                 else reuse.foldstack_enabled())
+    stacked_info = None
+    if use_stack:
+        if resume or warm_start:
+            raise ValueError(
+                "foldstack is incompatible with resume/warm_start: the "
+                "stacked fit writes fold checkpoints only at finalize "
+                "(nothing per-epoch to resume from) and the warm-start "
+                "carry is inherently serial — run those protocols with "
+                "the sequential walk-forward")
+        from lfm_quant_tpu.train.foldstack import run_stacked_walkforward
+
+        stacked = run_stacked_walkforward(
+            cfg, panel, folds, train_months=train_months,
+            out_dir=out_dir, echo=echo)
+        if stacked is not None:
+            fold_sums, fold_preds, stacked_info = stacked
+            for k, (fold, fs, pred) in enumerate(
+                    zip(folds, fold_sums, fold_preds)):
+                train_end, val_end, pred_range = fold
+                if het:
+                    fc, avar, v = pred
+                    variance[..., v] = avar[..., v]
+                else:
+                    fc, v = pred
+                assert not (valid & v).any(), \
+                    "fold prediction windows overlap"
+                forecast[..., v] = fc[..., v]
+                valid |= v
+                records.append({
+                    "fold": k,
+                    "train_end": train_end,
+                    "val_end": val_end,
+                    "pred_months": [int(panel.dates[pred_range[0]]),
+                                    int(panel.dates[pred_range[1] - 1])],
+                    "n_pred_cells": int(v.sum()),
+                    "best_val_ic": fs["best_val_ic"],
+                    "best_epoch": fs["best_epoch"],
+                    "epochs_run": fs["epochs_run"],
+                    "warm_started": False,
+                    "foldstack": True,
+                    "reuse": fs["reuse"],
+                })
+
     prev_params = None
     trainer = None
-    for k, (train_end, val_end, pred_range) in enumerate(folds):
+    for k, (train_end, val_end, pred_range) in enumerate(
+            folds if stacked_info is None else []):
         if k < len(records):
             continue  # fold already completed in a previous run
         # Per-fold compile/transfer accounting: the deltas land in the
@@ -327,25 +415,8 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
             # staying replayable.
             fold_cfg = dataclasses.replace(cfg, seed=cfg.seed + 1000 * k)
             if run_dir:
-                # Make every fold dir a standalone loadable run dir
-                # (load_trainer/load_ensemble): config.json pins the FOLD's
-                # split boundaries so a reload reconstructs the exact
-                # training-time splits, and the ensemble marker routes
-                # load_forecaster. Written BEFORE fit so a crashed fold is
-                # still inspectable. forecast.py uses the LAST fold — the
-                # model trained on the most recent data — for live rankings.
-                from lfm_quant_tpu.train.forecast import mark_ensemble_run_dir
-
-                os.makedirs(run_dir, exist_ok=True)
-                save_cfg = dataclasses.replace(
-                    fold_cfg, data=dataclasses.replace(
-                        fold_cfg.data, train_end=train_end, val_end=val_end,
-                        train_start=train_start))
-                with open(os.path.join(run_dir, "config.json"), "w") as fh:
-                    fh.write(save_cfg.to_json())
-                # Also CLEARS a stale flag when a reused dir flips trainer
-                # kind between runs.
-                mark_ensemble_run_dir(run_dir, ensemble)
+                write_fold_run_dir(fold_cfg, run_dir, train_end, val_end,
+                                   train_start, ensemble)
             # ONE trainer for the whole sweep, rebound per fold: rebind()
             # resets TrainState, sampler seeds and split boundaries without
             # rebuilding the jit wrappers (an unchanged program key keeps the
@@ -390,6 +461,7 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                                 int(panel.dates[pred_range[1] - 1])],
                 "n_pred_cells": int(v.sum()),
                 "best_val_ic": fit["best_val_ic"],
+                "best_epoch": fit["best_epoch"],
                 "epochs_run": fit["epochs_run"],
                 "warm_started": used_warm,
                 # Fold-level compile/transfer cost: 0 jit_traces and 0
@@ -423,6 +495,8 @@ def run_walkforward(cfg: RunConfig, panel: Panel, *, start: int,
                        int(panel.dates[folds[-1][2][1] - 1])],
         "folds": records,
     }
+    if stacked_info is not None:
+        summary["foldstack"] = stacked_info
     def _save_summary():
         if out_dir:
             with open(os.path.join(out_dir, "summary.json"), "w") as fh:

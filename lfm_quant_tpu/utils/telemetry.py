@@ -407,11 +407,20 @@ def begin_async(name: str, cat: str = "epoch", **args):
 
 
 def instant(name: str, cat: str = "mark", **args) -> None:
-    """A zero-duration marker event (early stop, fold boundary, ...)."""
+    """A zero-duration marker event (early stop, fold boundary, ...).
+    Emitted to the Chrome-trace stream AND as a zero-duration spans.jsonl
+    record, so offline rollups (scripts/trace_report.py — e.g. the
+    fold-stack section's per-fold ``fold_stopped`` marks) can read
+    markers without parsing the trace file."""
     run = _ACTIVE
     if run is None or not enabled():
         return
-    run._event("i", name, cat, time.perf_counter(), args=args)
+    t0 = time.perf_counter()
+    run._event("i", name, cat, t0, args=args)
+    stack = _stack()
+    run._record(name, cat, time.time(), t0, 0.0, args, {},
+                parent=stack[-1] if stack else None, depth=len(stack),
+                event=False)
 
 
 # ---- run manifest --------------------------------------------------------
@@ -422,6 +431,7 @@ _KNOB_PROBES = (
     ("donation", "lfm_quant_tpu.train.reuse", "donation_enabled"),
     ("async_pipeline", "lfm_quant_tpu.train.reuse", "async_enabled"),
     ("async_ckpt", "lfm_quant_tpu.train.reuse", "async_ckpt_enabled"),
+    ("foldstack", "lfm_quant_tpu.train.reuse", "foldstack_enabled"),
     ("jax_backtest", "lfm_quant_tpu.backtest", "jax_backtest_enabled"),
 )
 

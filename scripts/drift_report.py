@@ -22,6 +22,14 @@ from regen_baseline import (ledger_path, load_rows,  # noqa: E402
                             measurement_rows, row_key)
 
 
+def _fmt_value(v) -> str:
+    """Magnitude-aware value format: sub-10 metrics (speedup ratios like
+    1.76×) keep significant digits via ``.4g`` — the old ``,.0f`` rendered
+    1.76 as "2" — while big throughput numbers stay comma-grouped."""
+    v = float(v)
+    return f"{v:.4g}" if abs(v) < 10 else f"{v:,.0f}"
+
+
 def report(prefixes) -> int:
     rows = [r for r in measurement_rows(load_rows(ledger_path()))
             if isinstance(r.get("value"), (int, float))
@@ -36,7 +44,7 @@ def report(prefixes) -> int:
         impl = r.get("gather_impl") or r.get("scan_impl") or "-"
         spread = r.get("spread_pct")
         print(f"{r.get('ts', '?'):20} {r.get('metric', '?'):28} "
-              f"{impl:14} {r.get('value', 0):>14,.0f} "
+              f"{impl:14} {_fmt_value(r.get('value', 0)):>14} "
               f"{spread if spread is not None else '—':>6} "
               f"{r.get('rtt_ms') if r.get('rtt_ms') is not None else '—':>7}")
     # Group by the CANONICAL measurement identity (regen_baseline's
@@ -62,10 +70,14 @@ def report(prefixes) -> int:
                 if r.get("rtt_ms") is not None]
         rtt_note = ""
         if len(rtts) >= 2:
-            hi_rtt = max(rtts)[0]
-            lo_rtt = min(rtts)[0]
+            # Extremes by the rtt covariate ALONE: plain tuple max/min
+            # would tie-break equal rtts on throughput, silently picking
+            # the pairing that confirms the covariate story.
+            hi = max(rtts, key=lambda t: t[0])
+            lo = min(rtts, key=lambda t: t[0])
+            hi_rtt, lo_rtt = hi[0], lo[0]
             if hi_rtt and lo_rtt and hi_rtt > 1.5 * lo_rtt:
-                slower_at_hi = max(rtts)[1] < min(rtts)[1]
+                slower_at_hi = hi[1] < lo[1]
                 rtt_note = (" — rtt covariate moves with it"
                             if slower_at_hi else
                             " — rtt covariate does NOT explain it")

@@ -98,8 +98,16 @@ def sweep(block_sizes, eval_sizes=None) -> None:
             continue
         kw = dict(base.model.kwargs)
         if bb:
-            kw["scan_block_b"] = bb
-            kw["eval_scan_block_b"] = bb
+            # Eval-only points (e.g. the 4096 tail of the eval list) must
+            # set ONLY eval_scan_block_b: scan_block_b reaches the TRAIN
+            # step, and an eager train-step compile would lower a
+            # backward at a width the backward's VMEM budget never
+            # validated. Train points still mirror into the eval override
+            # so the eval half measures the same width.
+            if bb in block_sizes:
+                kw["scan_block_b"] = bb
+            if bb in eval_sizes:
+                kw["eval_scan_block_b"] = bb
         cfg = _scan_impl_override(dataclasses.replace(
             base, model=dataclasses.replace(base.model, kwargs=kw)))
         # The finally releases this point's device panel + compiled

@@ -80,13 +80,21 @@ def load_run(run_dir: str) -> Dict[str, Any]:
 def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
     """Roll the raw artifacts up into the printed/JSON report dict."""
     spans = run["spans"]
-    fits = [s for s in spans if s.get("name") == "fit"]
+    fits = [s for s in spans
+            if s.get("name") in ("fit", "foldstack_fit")]
     epochs = [s for s in spans if s.get("name") == "epoch"]
     runs = [s for s in spans if s.get("name") == "run"]
 
     fit_wall = sum(s.get("dur_s", 0.0) for s in fits)
-    n_epochs = sum(int(s.get("args", {}).get("epochs_run", 0))
-                   for s in fits)
+
+    def _fit_epochs(s):
+        # A fold-stacked fit's epochs_run is a per-fold list; the
+        # stacked loop runs max(folds) epochs of wall time.
+        v = s.get("args", {}).get("epochs_run", 0)
+        return max((int(x) for x in v), default=0) if isinstance(v, list) \
+            else int(v or 0)
+
+    n_epochs = sum(_fit_epochs(s) for s in fits)
     if n_epochs == 0:  # fit spans absent/foreign — fall back to counting
         n_epochs = sum(1 for s in epochs
                        if not s.get("args", {}).get("discarded"))
@@ -165,6 +173,29 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
         "top_spans": top_spans,
         "programs": ledger_rows,
     }
+    # Fold-stack attribution: per-fold epoch counts / best epochs from
+    # the foldstack_fit span args plus the per-fold stop marks, so a
+    # stacked run's report shows where each fold's share of the stacked
+    # wall went without re-deriving it from metrics files.
+    stacks = [s for s in fits if s.get("name") == "foldstack_fit"]
+    if stacks:
+        stops = [s.get("args", {}) for s in spans
+                 if s.get("name") == "fold_stopped"]
+        last = stacks[-1].get("args", {})
+        # Per-fit fields all scope to the LAST stacked fit (a bench-style
+        # run dir holds a warmup stack plus a timed one — mixing an
+        # aggregate fold count with last-fit stats would misattribute);
+        # n_stacked_fits says how many this run dir holds, and the
+        # early-stop marks span all of them.
+        report["foldstack"] = {
+            "n_stacked_fits": len(stacks),
+            "fold_count": int(last.get("fold_count", 0)),
+            "fold_mesh": last.get("fold_mesh"),
+            "epochs_per_fold": last.get("epochs_run"),
+            "best_epochs": last.get("best_epochs"),
+            "early_stops": [{"fold": a.get("fold"), "epoch": a.get("epoch")}
+                            for a in stops],
+        }
     m = run["manifest"]
     if m:
         jx = m.get("jax") if isinstance(m.get("jax"), dict) else {}
@@ -216,6 +247,15 @@ def print_report(rep: Dict[str, Any]) -> None:
           "throughput  : n/a (no fit spans)")
     if rep["idle_frac"] is not None:
         print(f"device idle : {100.0 * rep['idle_frac']:.1f}% of fit wall")
+    fs = rep.get("foldstack")
+    if fs:
+        extra = (f" (last of {fs['n_stacked_fits']} stacked fits)"
+                 if fs.get("n_stacked_fits", 1) > 1 else "")
+        print(f"fold stack  : {fs['fold_count']} folds{extra} "
+              f"mesh={fs.get('fold_mesh')}  "
+              f"epochs/fold={fs.get('epochs_per_fold')}  "
+              f"best={fs.get('best_epochs')}  "
+              f"early_stops={len(fs.get('early_stops') or [])}")
     print(f"host syncs  : {rep['host_syncs']} "
           f"({rep['syncs_per_epoch']}/epoch, {rep['host_sync_s']:.3f}s "
           f"blocked)" if rep["syncs_per_epoch"] is not None else

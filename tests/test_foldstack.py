@@ -1,0 +1,367 @@
+"""Fold-vectorized walk-forward (train/foldstack.py): parity + freezing.
+
+The fold-stack's contract is that stacking reorders WORK, never results:
+with ``LFM_FOLDSTACK`` on, every fold's epoch history, best epoch,
+early-stop epoch and restored best params must match its sequential run
+— across the LFM_FOLDSTACK × LFM_ASYNC knob matrix — a stopped fold's
+params must stay bit-frozen while other folds train, and the reuse
+lane's zero-warm-trace / zero-H2D contract must hold with fold-stacking
+ON. Tolerance policy: the UNSHARDED stack (``LFM_FOLDSTACK_SHARDS=0``)
+is pinned bit-identical; the fold-MESH stack is pinned to last-ulp
+reduction-order tolerance (the same caveat every sharded path in this
+repo states) with epochs/best-epoch decisions still exact.
+
+All tests carry the ``foldstack`` marker — the fast CI guard
+(``pytest -m foldstack``) against a refactor that quietly breaks the
+stacked/sequential numerical identity.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.train.walkforward import run_walkforward, walkforward_folds
+
+pytestmark = pytest.mark.foldstack
+
+#: History fields that must match across execution modes (timing fields
+#: — ts, firm_months_per_sec — legitimately differ). val_mse is compared
+#: with last-ulp tolerance even on the "exact" lane: its month-sum
+#: reassociates under the fold vmap (a logged diagnostic — no control
+#: decision reads it; val_ic, the early-stop input, stays bit-exact).
+_DET_FIELDS = ("epoch", "train_loss", "grad_norm", "val_ic", "val_mse",
+               "val_ic_std")
+_ULP_FIELDS = ("val_mse",)
+_WF_KW = dict(start=198001, step_months=12, val_months=24, n_folds=3,
+              train_months=72)
+
+
+def _cfg(tmp, epochs=3, patience=99, lr=1e-3, n_seeds=1):
+    return RunConfig(
+        name="fstk",
+        data=DataConfig(n_firms=100, n_months=200, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=lr, epochs=epochs, warmup_steps=5, loss="mse",
+                          early_stop_patience=patience),
+        seed=0,
+        n_seeds=n_seeds,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=100, n_months=200, n_features=5, seed=5)
+
+
+def _wf(tmp, panel, monkeypatch, *, stacked, async_on=True, name,
+        **cfg_kw):
+    monkeypatch.setenv("LFM_ASYNC", "1" if async_on else "0")
+    monkeypatch.setenv("LFM_ASYNC_CKPT", "1" if async_on else "0")
+    out = str(tmp / name)
+    fc, valid, summary = run_walkforward(
+        _cfg(tmp, **cfg_kw), panel, out_dir=out, foldstack=stacked,
+        **_WF_KW)
+    return fc, valid, summary, out
+
+
+def _histories(out_dir, n_folds):
+    return [
+        [json.loads(l) for l in
+         open(os.path.join(out_dir, f"fold_{k}", "metrics.jsonl"))]
+        for k in range(n_folds)
+    ]
+
+
+def _det(history):
+    return [tuple((f, r[f]) for f in _DET_FIELDS
+                  if f in r and f not in _ULP_FIELDS)
+            for r in history]
+
+
+def _best_params(out_dir, k, panel):
+    from lfm_quant_tpu.train.forecast import is_ensemble_run_dir
+
+    run_dir = os.path.join(out_dir, f"fold_{k}")
+    if is_ensemble_run_dir(run_dir):
+        from lfm_quant_tpu.train.ensemble import load_ensemble
+
+        trainer, _ = load_ensemble(run_dir, panel=panel)
+    else:
+        from lfm_quant_tpu.train.loop import load_trainer
+
+        trainer, _ = load_trainer(run_dir, panel=panel)
+    return trainer.state.params
+
+
+def _assert_parity(seq, stk, panel, exact, n_folds=3, check_params=False):
+    """Shared contract: records, histories, stitched forecasts and (for
+    the key lanes) best params restored from each fold's ckpt/best line.
+    ``exact`` pins bit-identity; otherwise float history fields and
+    forecasts get last-ulp tolerance while every DECISION (epochs run,
+    best epoch, early-stop epoch) stays exact."""
+    fc_s, v_s, sum_s, d_s = seq
+    fc_k, v_k, sum_k, d_k = stk
+    assert sum_k.get("foldstack", {}).get("enabled") is True
+    assert "foldstack" not in sum_s
+    np.testing.assert_array_equal(v_s, v_k)
+    for rs, rk in zip(sum_s["folds"], sum_k["folds"]):
+        assert rs["epochs_run"] == rk["epochs_run"], rs["fold"]
+        assert rs["best_epoch"] == rk["best_epoch"], rs["fold"]
+        np.testing.assert_allclose(rk["best_val_ic"], rs["best_val_ic"],
+                                   rtol=0 if exact else 1e-5)
+    hs, hk = _histories(d_s, n_folds), _histories(d_k, n_folds)
+    for k, (a, b) in enumerate(zip(hs, hk)):
+        assert [r["epoch"] for r in a] == [r["epoch"] for r in b], k
+        if exact:
+            assert _det(a) == _det(b), f"fold {k} history diverged"
+            for ra, rb in zip(a, b):
+                for f in _ULP_FIELDS:
+                    if f in ra:
+                        np.testing.assert_allclose(rb[f], ra[f], rtol=1e-6,
+                                                   err_msg=f"fold {k} {f}")
+        else:
+            for ra, rb in zip(a, b):
+                for f in _DET_FIELDS:
+                    if f in ra:
+                        np.testing.assert_allclose(rb[f], ra[f], rtol=2e-5,
+                                                   err_msg=f"fold {k} {f}")
+    if exact:
+        np.testing.assert_array_equal(fc_s, fc_k)
+    else:
+        np.testing.assert_allclose(fc_k, fc_s, atol=5e-6, rtol=1e-4)
+    if not check_params:
+        return
+    for k in range(n_folds):
+        ps = jax.tree.leaves(_best_params(d_s, k, panel))
+        pk = jax.tree.leaves(_best_params(d_k, k, panel))
+        for a, b in zip(ps, pk):
+            if exact:
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            else:
+                np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                           atol=5e-6, rtol=1e-4)
+
+
+def test_unsharded_stack_bit_identical(panel, tmp_path, monkeypatch):
+    """LFM_FOLDSTACK_SHARDS=0 (pure vmap over the fold axis): histories,
+    stitched forecasts and restored best params are BIT-identical to
+    sequential execution — stacking is a pure re-batching."""
+    monkeypatch.setenv("LFM_FOLDSTACK_SHARDS", "0")
+    seq = _wf(tmp_path, panel, monkeypatch, stacked=False, name="seq")
+    stk = _wf(tmp_path, panel, monkeypatch, stacked=True, name="stk")
+    assert stk[2]["foldstack"]["fold_mesh"] is None
+    _assert_parity(seq, stk, panel, exact=True, check_params=True)
+
+
+def test_fold_mesh_parity_matrix(panel, tmp_path, monkeypatch):
+    """The LFM_FOLDSTACK × LFM_ASYNC knob matrix under the (default)
+    fold mesh: per-fold histories/forecasts within last-ulp
+    reduction-order tolerance, every early-stop/best decision exact.
+    The fold axis must actually shard (this suite pins an 8-device CPU
+    platform)."""
+    for async_on in (False, True):
+        tag = "a" if async_on else "s"
+        seq = _wf(tmp_path, panel, monkeypatch, stacked=False,
+                  async_on=async_on, name=f"mseq_{tag}")
+        stk = _wf(tmp_path, panel, monkeypatch, stacked=True,
+                  async_on=async_on, name=f"mstk_{tag}")
+        if jax.device_count() > 1:
+            assert dict(stk[2]["foldstack"]["fold_mesh"])["fold"] > 1
+        _assert_parity(seq, stk, panel, exact=False)
+
+
+def test_divergent_early_stop_parity(panel, tmp_path, monkeypatch):
+    """Folds stopping at DIFFERENT epochs (patience=1): per-fold
+    early-stop epochs and best epochs must match sequential execution
+    exactly — the masking-based device-side control reproduces the
+    FitHarness decisions fold by fold, with live folds continuing after
+    their neighbors froze."""
+    kw = dict(epochs=10, patience=1)
+    seq = _wf(tmp_path, panel, monkeypatch, stacked=False, name="es_seq",
+              **kw)
+    stk = _wf(tmp_path, panel, monkeypatch, stacked=True, name="es_stk",
+              **kw)
+    epochs_seq = [r["epochs_run"] for r in seq[2]["folds"]]
+    assert epochs_seq == [r["epochs_run"] for r in stk[2]["folds"]]
+    assert max(epochs_seq) < 10, "geometry must actually early-stop"
+    assert len(set(epochs_seq)) > 1, \
+        "fold stop epochs must diverge for this test to bite"
+    _assert_parity(seq, stk, panel, exact=False, check_params=True)
+
+
+def test_stopped_fold_is_bit_frozen(panel, tmp_path, monkeypatch):
+    """Drive the stacked epoch program directly with a forged live mask:
+    the dead folds' ENTIRE TrainState (params, optimizer moments, step
+    counter, dropout stream) must come back bit-identical while the live
+    fold's state moves — the masking contract that makes divergent early
+    stopping safe."""
+    monkeypatch.setenv("LFM_ASYNC", "1")
+    from lfm_quant_tpu.train.foldstack import StackedWalkforward
+
+    cfg = _cfg(tmp_path)
+    folds = walkforward_folds(panel, _WF_KW["start"],
+                              _WF_KW["step_months"], _WF_KW["val_months"],
+                              _WF_KW["n_folds"])
+    sw = StackedWalkforward(cfg, panel, folds,
+                            train_months=_WF_KW["train_months"])
+    state, best, ctrl = sw.init_carry()
+    live = jnp.asarray([True, False, False])
+    ctrl = ctrl._replace(live=jax.device_put(
+        live, ctrl.live.sharding) if hasattr(ctrl.live, "sharding")
+        else live)
+    before = jax.device_get(state._asdict())  # host copy pre-donation
+    args, _ = sw.build_epoch(0)
+    (state2, _, ctrl2), _ = sw.dispatch_epoch((state, best, ctrl), args)
+    after = jax.device_get(state2._asdict())
+    for key in before:
+        for a, b in zip(jax.tree.leaves(before[key]),
+                        jax.tree.leaves(after[key])):
+            a, b = np.asarray(a), np.asarray(b)
+            np.testing.assert_array_equal(a[1:], b[1:],
+                                          err_msg=f"dead folds moved: {key}")
+    moved = any(
+        not np.array_equal(np.asarray(a)[0], np.asarray(b)[0])
+        for a, b in zip(jax.tree.leaves(before["params"]),
+                        jax.tree.leaves(after["params"])))
+    assert moved, "the live fold's params did not train"
+    # Dead folds never re-enter the live set; the live fold keeps going.
+    live_out = np.asarray(jax.device_get(ctrl2.live))
+    assert not live_out[1] and not live_out[2]
+
+
+@pytest.mark.reuse
+def test_foldstack_warm_run_zero_traces_zero_transfers(panel, tmp_path,
+                                                       monkeypatch):
+    """The reuse lane's contract with fold-stacking ON: a SECOND stacked
+    sweep binds the first one's fold-stacked executables and resident
+    panel — zero new jit traces, zero panel H2D — and the stacked fit
+    pays exactly ONE counted blocking host sync per stacked epoch (the
+    PR 3 pipeline contract through the fold-stack driver)."""
+    from lfm_quant_tpu.data.windows import clear_panel_cache
+    from lfm_quant_tpu.train import reuse
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    try:
+        _wf(tmp_path, panel, monkeypatch, stacked=True, name="warmup")
+        snap = REUSE_COUNTERS.snapshot()
+        _, _, summary, _ = _wf(tmp_path, panel, monkeypatch, stacked=True,
+                               name="warm")
+        d = REUSE_COUNTERS.delta(snap)
+        assert d["jit_traces"] == 0, d
+        assert d["panel_transfers"] == 0, d
+        stack = summary["foldstack"]
+        epochs = max(r["epochs_run"] for r in summary["folds"])
+        assert stack["reuse"]["host_syncs"] == epochs, stack["reuse"]
+    finally:
+        reuse.clear_program_cache()
+        clear_panel_cache()
+
+
+def test_no_out_dir_predicts_last_epoch_state_like_sequential(
+        panel, tmp_path, monkeypatch):
+    """out_dir=None parity: sequential folds have no ckpt/best line to
+    restore, so they predict from the last RECORDED epoch's state — the
+    stacked path must mirror that (its device-tracked best params serve
+    only runs that checkpoint), or LFM_FOLDSTACK would silently flip
+    forecasts for non-checkpointing callers. patience=1 makes best and
+    last epoch genuinely differ."""
+    monkeypatch.setenv("LFM_FOLDSTACK_SHARDS", "0")  # bit-exact lane
+    monkeypatch.setenv("LFM_ASYNC", "1")
+    cfg = _cfg(tmp_path, epochs=10, patience=1)
+    out = {}
+    for stacked in (False, True):
+        fc, _, summary = run_walkforward(panel=panel, cfg=cfg,
+                                         out_dir=None, foldstack=stacked,
+                                         **_WF_KW)
+        out[stacked] = (fc, summary)
+    assert any(r["best_epoch"] != r["epochs_run"] - 1
+               for r in out[True][1]["folds"]
+               if "best_epoch" in r), "best must differ from last epoch"
+    np.testing.assert_array_equal(out[False][0], out[True][0])
+
+
+def test_env_knob_enables_foldstack(panel, tmp_path, monkeypatch):
+    """LFM_FOLDSTACK=1 routes run_walkforward through the stacked path
+    without the explicit argument (the --wf-foldstack CLI equivalent)."""
+    monkeypatch.setenv("LFM_FOLDSTACK", "1")
+    _, _, summary, _ = _wf(tmp_path, panel, monkeypatch, stacked=None,
+                           name="env")
+    assert summary["foldstack"]["enabled"] is True
+    assert all(r["foldstack"] for r in summary["folds"])
+
+
+def test_foldstack_without_rolling_window_degrades(panel, tmp_path,
+                                                   monkeypatch):
+    """No train_months (expanding window → fold-varying shapes): the
+    stacked mode must WARN and fall back to the sequential sweep with
+    identical results — a data-dependent precondition failure never
+    kills a sweep the sequential path handles."""
+    kw = {**_WF_KW}
+    kw.pop("train_months")
+    cfg = _cfg(tmp_path, epochs=2)
+    with pytest.warns(UserWarning, match="fold-stacking unavailable"):
+        fc_k, v_k, sum_k = run_walkforward(
+            cfg, panel, out_dir=str(tmp_path / "fb_stk"), foldstack=True,
+            **kw)
+    assert "foldstack" not in sum_k
+    fc_s, v_s, _ = run_walkforward(
+        cfg, panel, out_dir=str(tmp_path / "fb_seq"), foldstack=False,
+        **kw)
+    np.testing.assert_array_equal(fc_s, fc_k)
+    np.testing.assert_array_equal(v_s, v_k)
+
+
+def test_foldstack_rejects_resume_and_warm_start(panel, tmp_path):
+    """resume/warm_start are inherently serial (per-epoch checkpoint
+    lines; predecessor-fold carry) — the stacked mode refuses them
+    loudly instead of silently changing their semantics."""
+    cfg = _cfg(tmp_path, epochs=2)
+    for kw in (dict(resume=True), dict(warm_start=True)):
+        with pytest.raises(ValueError, match="foldstack is incompatible"):
+            run_walkforward(cfg, panel, out_dir=str(tmp_path / "rej"),
+                            foldstack=True, **_WF_KW, **kw)
+
+
+def test_ensemble_coprime_seeds_fold_only_mesh(panel, tmp_path,
+                                               monkeypatch):
+    """n_seeds coprime to the device count (3 on an 8-device host): the
+    inner ensemble mesh degrades to None, so the stack runs over a
+    fold-ONLY mesh — the batch specs must not name absent seed/data
+    axes (this crashed before the spec guard), and parity still holds."""
+    kw = dict(n_seeds=3, epochs=2)
+    seq = _wf(tmp_path, panel, monkeypatch, stacked=False, name="cp_seq",
+              **kw)
+    stk = _wf(tmp_path, panel, monkeypatch, stacked=True, name="cp_stk",
+              **kw)
+    mesh = stk[2]["foldstack"]["fold_mesh"]
+    if jax.device_count() > 1:
+        assert dict(mesh or []).get("seed") is None
+    _assert_parity(seq, stk, panel, exact=False)
+
+
+def test_ensemble_foldstack_parity(panel, tmp_path, monkeypatch):
+    """The seed-vmapped ensemble under the fold stack: the fold axis
+    composes OUTSIDE the seed (× data) mesh axes, and per-fold ensemble
+    histories (train_loss, mean/std val IC), best epochs and stitched
+    stacked forecasts match the sequential ensemble sweep."""
+    kw = dict(n_seeds=2, epochs=2)
+    seq = _wf(tmp_path, panel, monkeypatch, stacked=False, name="ens_seq",
+              **kw)
+    stk = _wf(tmp_path, panel, monkeypatch, stacked=True, name="ens_stk",
+              **kw)
+    mesh = stk[2]["foldstack"]["fold_mesh"]
+    if jax.device_count() > 1:
+        assert dict(mesh)["seed"] == 2
+    # check_params also proves the stacked ensemble fold dirs RESTORE
+    # (the [S]-shaped step leaf must round-trip through load_ensemble).
+    _assert_parity(seq, stk, panel, exact=False, check_params=True)

@@ -63,6 +63,13 @@ def main(argv=None) -> int:
                          "expanding window). Fixed-length folds keep "
                          "identical batch shapes, so the cross-fold reuse "
                          "layer compiles the whole sweep exactly once")
+    ap.add_argument("--wf-foldstack", action="store_true",
+                    help="train ALL same-shape folds as ONE stacked, "
+                         "fold-sharded, pipelined program "
+                         "(train/foldstack.py; needs --wf-train-months) "
+                         "instead of sequential fits — per-fold results "
+                         "match sequential execution; LFM_FOLDSTACK=1 is "
+                         "the env equivalent")
     ap.add_argument("--wf-score", metavar="MODES", default=None,
                     help="grade the stitched out-of-sample panel at the "
                          "end of the sweep: comma-separated aggregation "
@@ -76,10 +83,18 @@ def main(argv=None) -> int:
     if args.walk_forward is None and (
             args.wf_start is not None or args.wf_folds is not None
             or args.wf_val_months != 24 or args.wf_warm_start
-            or args.wf_train_months is not None or args.wf_score is not None):
+            or args.wf_train_months is not None or args.wf_score is not None
+            or args.wf_foldstack):
         ap.error("--wf-start/--wf-val-months/--wf-folds/--wf-warm-start/"
-                 "--wf-train-months/--wf-score need --walk-forward "
-                 "STEP_MONTHS")
+                 "--wf-train-months/--wf-score/--wf-foldstack need "
+                 "--walk-forward STEP_MONTHS")
+    if args.wf_foldstack and args.wf_train_months is None:
+        ap.error("--wf-foldstack needs --wf-train-months (fold-stacking "
+                 "requires the rolling-window same-shape schedule)")
+    if args.wf_foldstack and (args.wf_warm_start or args.resume):
+        ap.error("--wf-foldstack is incompatible with --wf-warm-start/"
+                 "--resume (the stacked fit checkpoints folds only at "
+                 "finalize; the warm-start carry is serial)")
     wf_score_modes = None
     if args.wf_score:
         # Validate HERE, not at end-of-sweep: a typo'd mode must fail at
@@ -176,7 +191,8 @@ def main(argv=None) -> int:
                 out_dir=wf_dir, echo=args.echo, resume=args.resume,
                 warm_start=args.wf_warm_start,
                 train_months=args.wf_train_months,
-                score_modes=wf_score_modes)
+                score_modes=wf_score_modes,
+                foldstack=True if args.wf_foldstack else None)
             summary["run_dir"] = wf_dir
         elif cfg.n_seeds > 1:
             from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
