@@ -720,6 +720,123 @@ def bench_scoring_pipeline() -> None:
     _emit("scoring_pipeline", fused[0], 0.0, **extras)
 
 
+def bench_serve() -> None:
+    """serve — the always-on scoring-service metric: sustained scoring
+    requests/sec through the zoo + micro-batcher + compiled-core path
+    (lfm_quant_tpu/serve/), plus the latency (p50/p99 ms) and batch-
+    occupancy distribution and the STEADY-STATE compile count (jit
+    traces + panel H2D after warmup — the serving contract is both are
+    ZERO; a non-zero value in this row is a regression, not noise).
+    Mixed-shape traffic on purpose: universes with distinct
+    cross-section sizes and lookbacks exercise the request-shape bucket
+    ladder, which is what makes arbitrary queries compile-free. Toy
+    models/universes on purpose: the metric prices the SERVING LOOP
+    (queueing, coalescing, padding, dispatch, D2H, fan-out), not model
+    FLOPs — c2/c5 own model throughput, scoring_pipeline owns the
+    batch path. The p50/p99 in the row are cross-checked at measurement
+    time against scripts/trace_report.py's rollup of the same run dir
+    (same per-request latency_ms values — the agreement is a pinned
+    contract, reported in the row as trace_p50_diff_pct: percent
+    DISAGREEMENT, 0.0 = exact reproduction, the serve lane pins <=1)."""
+    import shutil
+    import tempfile
+
+    import serve as serve_mod
+    from lfm_quant_tpu.serve import ScoringService
+    from lfm_quant_tpu.utils import telemetry
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    n_requests = int(os.environ.get("LFM_BENCH_SERVE_REQUESTS", "300"))
+    n_threads = int(os.environ.get("LFM_BENCH_SERVE_THREADS", "4"))
+    n_universes = int(os.environ.get("LFM_BENCH_SERVE_UNIVERSES", "3"))
+    reps = max(1, int(os.environ.get("LFM_BENCH_OUTER_REPS", "3")))
+    rtt = dispatch_rtt_ms()  # covariate BEFORE measuring (contract)
+    run_dir = tempfile.mkdtemp(prefix="lfm_serve_bench_")
+    try:
+        svc = ScoringService()
+        for name, (trainer, _) in serve_mod.build_universes(
+                n_universes, train_epochs=0).items():
+            svc.register(name, trainer)  # warm: compiles every bucket
+
+        drive_errors: list = []
+
+        def drive() -> float:
+            # serve.py's closed-loop client driver IS the load pattern
+            # (one implementation — the bench row and the demo cannot
+            # drift apart on it); errors are tallied, not swallowed: a
+            # dead client thread would otherwise leave its claimed
+            # requests unserved while the row still reported
+            # n_requests/elapsed as throughput.
+            wall, errors, _ = serve_mod.drive_load(svc, n_requests,
+                                                   n_threads)
+            drive_errors.extend(errors)
+            return n_requests / wall
+
+        drive()  # warmup rep: first D2H/readback paths settle
+        # Steady state begins HERE: counters snapshotted, the rolling
+        # stats window zeroed (warmup errors dropped with it), and the
+        # telemetry run attached — so the row's percentiles, errors,
+        # spans in the run dir, and compile/H2D deltas all cover
+        # exactly the timed reps (which is also what makes the
+        # trace_report cross-check below exact).
+        svc.batcher.reset_stats()
+        drive_errors.clear()
+        snap = REUSE_COUNTERS.snapshot()
+        with telemetry.run_scope(run_dir, extra={"entry": "bench_serve"}):
+            rates = sorted(drive() for _ in range(reps))
+        steady = REUSE_COUNTERS.delta(snap)
+        stats = svc.stats()
+        svc.close()
+        for e in drive_errors[:5]:
+            print(f"[bench] serve request error: {e}", file=sys.stderr,
+                  flush=True)
+        # Cross-check against the offline rollup of the SAME run dir:
+        # trace_report must reproduce the service's p50/p99 from the
+        # serve_request spans alone (identical latency_ms values).
+        trace_p50 = trace_p99 = diff_pct = None
+        try:
+            from lfm_quant_tpu.serve.stats import load_trace_report
+
+            tr = load_trace_report(os.path.dirname(os.path.abspath(
+                __file__)))
+            srep = tr.build_report(tr.load_run(run_dir)).get("serve") or {}
+            trace_p50 = srep.get("p50_ms")
+            trace_p99 = srep.get("p99_ms")
+            if trace_p50 and stats.get("p50_ms"):
+                # Percent DISAGREEMENT (0.0 = the offline rollup
+                # reproduced the service's p50 exactly; the serve lane
+                # pins ≤ 1).
+                diff_pct = round(100.0 * abs(trace_p50 - stats["p50_ms"])
+                                 / stats["p50_ms"], 3)
+        except Exception as e:  # noqa: BLE001 — cross-check is a covariate
+            print(f"[bench] serve trace_report cross-check failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+    med = rates[len(rates) // 2]
+    extras = {
+        "unit": "requests/sec",
+        "p50_ms": stats.get("p50_ms"),
+        "p99_ms": stats.get("p99_ms"),
+        "mean_occupancy": stats.get("mean_occupancy"),
+        "queue_peak": stats.get("queue_peak"),
+        "compiles_steady_state": steady.get("jit_traces", 0),
+        "panel_h2d_steady_state": steady.get("panel_transfers", 0),
+        "request_errors": len(drive_errors),
+        "n_universes": n_universes,
+        "n_requests": n_requests,
+        "n_threads": n_threads,
+        "n_reps": reps,
+        "rep_values": [round(r, 1) for r in rates],
+        "trace_p50_ms": trace_p50,
+        "trace_p99_ms": trace_p99,
+        "trace_p50_diff_pct": diff_pct,
+    }
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("serve", med, 0.0, **extras)
+
+
 def bench_epoch_pipeline() -> None:
     """epoch_pipeline — the async training-loop metric: epochs/hour on a
     CHECKPOINT-ENABLED multi-epoch fit with the one-epoch-lookahead
@@ -1177,7 +1294,8 @@ def main() -> int:
             if (os.environ.get("LFM_BENCH_FAKE_WEDGE") != "1"
                     and probe.get("kind") == "tunnel_wedged"):
                 for flag in ("--walkforward-reuse", "--walkforward-foldstack",
-                             "--scoring-pipeline", "--epoch-pipeline"):
+                             "--scoring-pipeline", "--epoch-pipeline",
+                             "--serve"):
                     _cpu_metric_fallback(
                         flag,
                         deadline_s - (time.monotonic() - t_start) - 30.0)
@@ -1237,6 +1355,14 @@ def main() -> int:
             _emit_status("bench_error", stage="epoch_pipeline",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
+        try:
+            bench_serve()
+        except Exception as e:  # noqa: BLE001 — earlier rows must still reach the driver
+            print(f"bench_serve failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            _emit_status("bench_error", stage="serve",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
         return 0
     except Exception as e:  # noqa: BLE001 — NO exit path may skip the record
         _emit_status("bench_error", stage="harness",
@@ -1277,4 +1403,6 @@ if __name__ == "__main__":
     if "--epoch-pipeline" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_epoch_pipeline,
                                      "epoch_pipeline"))
+    if "--serve" in sys.argv[1:]:
+        sys.exit(_single_metric_main(bench_serve, "serve"))
     sys.exit(main())

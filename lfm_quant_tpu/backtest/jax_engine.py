@@ -56,6 +56,7 @@ falls back to the numpy engine) — see ``resolve_backtest`` in
 from __future__ import annotations
 
 import functools
+import threading
 import weakref
 from typing import Dict, Sequence, Tuple, Union
 
@@ -92,29 +93,41 @@ ModeSpec = Union[str, Tuple[str, float]]
 # fused core vmaps over months. Same identity-keyed + weakref-evicted
 # contract as the training panel cache.
 
+_SCORE_PANEL_LOCK = threading.Lock()
 _SCORE_PANEL_CACHE: dict = {}
 
 
 def _device_score_panel(panel: Panel) -> dict:
+    # Lock-guarded like the training residency cache (data/windows.py):
+    # the serving process backtests from request/refresh threads, and a
+    # cold-panel race must pay ONE transfer, not two aliased entries.
     key = id(panel)
-    hit = _SCORE_PANEL_CACHE.get(key)
-    if hit is not None:
-        return hit
-    dev = {
-        "returns": jnp.asarray(np.ascontiguousarray(panel.returns.T)),
-        "targets": jnp.asarray(np.ascontiguousarray(panel.targets.T)),
-        "target_valid": jnp.asarray(
-            np.ascontiguousarray(panel.target_valid.T)),
-        "tradeable": jnp.asarray(np.ascontiguousarray(panel.tradeable().T)),
-    }
-    _SCORE_PANEL_CACHE[key] = dev
-    weakref.finalize(panel, _SCORE_PANEL_CACHE.pop, key, None)
-    return dev
+    with _SCORE_PANEL_LOCK:
+        hit = _SCORE_PANEL_CACHE.get(key)
+        if hit is not None:
+            return hit
+        dev = {
+            "returns": jnp.asarray(np.ascontiguousarray(panel.returns.T)),
+            "targets": jnp.asarray(np.ascontiguousarray(panel.targets.T)),
+            "target_valid": jnp.asarray(
+                np.ascontiguousarray(panel.target_valid.T)),
+            "tradeable": jnp.asarray(
+                np.ascontiguousarray(panel.tradeable().T)),
+        }
+        _SCORE_PANEL_CACHE[key] = dev
+        weakref.finalize(panel, _gc_pop_score, key)
+        return dev
+
+
+def _gc_pop_score(key) -> None:
+    with _SCORE_PANEL_LOCK:
+        _SCORE_PANEL_CACHE.pop(key, None)
 
 
 def clear_score_panel_cache() -> None:
     """Drop all device-resident scoring panels (tests / memory pressure)."""
-    _SCORE_PANEL_CACHE.clear()
+    with _SCORE_PANEL_LOCK:
+        _SCORE_PANEL_CACHE.clear()
 
 
 def invalidate_score_panel(panel: Panel) -> int:
@@ -122,11 +135,14 @@ def invalidate_score_panel(panel: Panel) -> int:
     ``data/windows.invalidate_panel`` so ONE invalidation hook covers
     both residency caches — a panel mutated in place must never be
     scored against stale device returns/targets. Returns entries
-    dropped."""
-    if id(panel) in _SCORE_PANEL_CACHE:
-        del _SCORE_PANEL_CACHE[id(panel)]
-        return 1
-    return 0
+    dropped. (Dispatches already in flight hold Python references to
+    the arrays, so dropping the dict entry can never tear a live
+    dispatch — same contract as the training cache's deferred drop.)"""
+    with _SCORE_PANEL_LOCK:
+        if id(panel) in _SCORE_PANEL_CACHE:
+            del _SCORE_PANEL_CACHE[id(panel)]
+            return 1
+        return 0
 
 
 @functools.lru_cache(maxsize=32)

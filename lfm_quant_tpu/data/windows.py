@@ -28,7 +28,9 @@ SURVEY.md §8 step 8 says to decide up front.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 import weakref
 from typing import Iterator, Optional
 
@@ -324,6 +326,19 @@ class DateBatchSampler:
         agree on it before their eval sweeps can stack)."""
         return int(self._all_dates.size)
 
+    def months_with_anchors(self) -> np.ndarray:
+        """Month indices (panel columns) with ≥1 eligible anchor — the
+        scoring service's serveable-month probe (int32, sorted)."""
+        return self._all_dates.copy()
+
+    def cross_section(self, t: int) -> np.ndarray:
+        """Month ``t``'s eligible firm pool (int32 panel rows; empty
+        when the month has no eligible anchor). The per-request universe
+        the serving micro-batcher pads into a bucket row."""
+        pool = self._firms_by_date.get(int(t))
+        return (pool.copy() if pool is not None
+                else np.zeros(0, dtype=np.int32))
+
     def full_cross_sections(self) -> Iterator[WindowIndex]:
         """Deterministic sweep over every eligible (date, firm) pair, for
         eval/inference: each batch is one date's full cross-section padded
@@ -443,10 +458,14 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
     ``panel.n_features + 1`` (callers pass it as ``fp``); phantom months
     carry validity 0.
     """
-    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+    from lfm_quant_tpu.utils.telemetry import COUNTERS
 
     put = (lambda x: jax.device_put(x, sharding)) if sharding is not None else jnp.asarray
-    REUSE_COUNTERS.panel_transfers += 1
+    # Locked bump, not the property view's `+=`: cold transfers of
+    # DIFFERENT panels can now run concurrently (the residency cache
+    # builds outside its lock), and a read-modify-write would lose
+    # increments the reuse lanes assert on exactly.
+    COUNTERS.bump("panel_transfers")
     xm = np.concatenate(
         [panel.features, panel.valid[..., None].astype(panel.features.dtype)],
         axis=-1,
@@ -478,9 +497,9 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
     if raw:
         dev["features"] = put(panel.features)
         dev["valid"] = put(panel.valid)
-    REUSE_COUNTERS.panel_bytes += int(
+    COUNTERS.bump("panel_bytes", int(
         xm.nbytes + panel.targets.nbytes + panel.target_valid.nbytes
-        + (panel.features.nbytes + panel.valid.nbytes if raw else 0))
+        + (panel.features.nbytes + panel.valid.nbytes if raw else 0)))
     return dev
 
 
@@ -497,8 +516,37 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
 # explicit invalidate_panel() call, same contract as any residency
 # cache. Garbage-collected panels evict themselves (weakref.finalize),
 # so id() reuse can never alias a dead entry.
+#
+# Concurrency (serving): the scoring service dispatches from a
+# micro-batcher thread while a refresh fit (or an operator invalidation)
+# runs on another, so the cache is lock-guarded and every entry carries
+# a LEASE COUNT. ``lease_device_panel`` pins an entry for the duration
+# of a dispatch; ``invalidate_panel`` during an in-flight lease removes
+# the entry from the cache immediately (new readers re-transfer fresh
+# bytes) but defers the final drop to the last release — a live
+# dispatch can never observe its panel arrays torn out from under it,
+# and two racing readers can never double-transfer the same panel.
 
-_PANEL_CACHE: dict = {}
+_PANEL_LOCK = threading.RLock()
+_PANEL_CACHE: dict = {}  # key -> _PanelEntry
+
+
+class _PanelEntry:
+    """One resident device panel + its residency bookkeeping.
+
+    ``dev`` is None while the H2D transfer is still in flight (the
+    ``ready`` event gates waiters); the entry enters the cache as a
+    placeholder FIRST so same-key racers wait instead of
+    double-transferring, while different keys proceed untouched."""
+
+    __slots__ = ("key", "dev", "leases", "doomed", "ready")
+
+    def __init__(self, key):
+        self.key = key
+        self.dev: Optional[dict] = None
+        self.leases = 0       # in-flight dispatches pinning this entry
+        self.doomed = False   # invalidated while leased — drop on release
+        self.ready = threading.Event()
 
 
 def _panel_cache_key(panel, mesh, compute_dtype, raw, lane_pad):
@@ -507,6 +555,68 @@ def _panel_cache_key(panel, mesh, compute_dtype, raw, lane_pad):
     return (id(panel), mesh_fingerprint(mesh),
             jnp.dtype(compute_dtype).name if compute_dtype is not None
             else None, bool(raw), bool(lane_pad))
+
+
+def _gc_pop(key) -> None:
+    with _PANEL_LOCK:
+        _PANEL_CACHE.pop(key, None)
+
+
+def _get_or_transfer(panel: Panel, mesh, compute_dtype, raw,
+                     lane_pad) -> "_PanelEntry":
+    """Entry for the key, transferring on miss. Two threads racing a
+    cold key pay exactly ONE H2D: the first inserts a placeholder entry
+    and transfers OUTSIDE the cache lock; same-key racers wait on the
+    entry's ready event; other keys' readers (the serving hot path
+    leasing an already-resident panel) are never blocked behind a
+    multi-second cold transfer — a refresh binding a new panel must not
+    spike every universe's serving latency."""
+    # Imported BEFORE any placeholder is inserted: an import failure
+    # (or an interrupt delivered inside it) after the placeholder
+    # would strand a never-ready entry that hangs all future readers.
+    from lfm_quant_tpu.parallel.mesh import replicated
+
+    key = _panel_cache_key(panel, mesh, compute_dtype, raw, lane_pad)
+    while True:
+        with _PANEL_LOCK:
+            entry = _PANEL_CACHE.get(key)
+            if entry is not None and entry.dev is not None:
+                from lfm_quant_tpu.utils.telemetry import COUNTERS
+
+                # Locked bump for the same reason as device_panel's
+                # transfer counters — no bare `+=` RMWs on counters the
+                # lanes assert exact values on.
+                COUNTERS.bump("panel_cache_hits")
+                return entry
+            if entry is None:
+                entry = _PANEL_CACHE[key] = _PanelEntry(key)
+                # Evict on panel gc: entries must never outlive their
+                # panel (id() reuse would silently serve another
+                # panel's bytes).
+                weakref.finalize(panel, _gc_pop, key)
+                building = True
+            else:
+                building = False  # someone else's transfer in flight
+        if not building:
+            entry.ready.wait()
+            continue  # re-read: ready entry, or invalidated → rebuild
+        try:
+            sharding = replicated(mesh) if mesh is not None else None
+            dev = device_panel(panel, sharding,
+                               compute_dtype=compute_dtype, raw=raw,
+                               lane_pad=lane_pad)
+        except BaseException:
+            with _PANEL_LOCK:
+                if _PANEL_CACHE.get(key) is entry:
+                    del _PANEL_CACHE[key]
+            entry.ready.set()  # waiters retry (and become the builder)
+            raise
+        entry.dev = dev
+        entry.ready.set()
+        # The entry may have been invalidated mid-transfer (popped +
+        # doomed): it still serves THIS caller — fresh bytes from the
+        # live panel object — and waiters re-read the cache.
+        return entry
 
 
 def cached_device_panel(panel: Panel, mesh=None, compute_dtype=None,
@@ -522,23 +632,33 @@ def cached_device_panel(panel: Panel, mesh=None, compute_dtype=None,
     traffic — and bumps ``REUSE_COUNTERS.panel_cache_hits``; a miss
     transfers via device_panel (which bumps the transfer counters).
     """
-    key = _panel_cache_key(panel, mesh, compute_dtype, raw, lane_pad)
-    hit = _PANEL_CACHE.get(key)
-    if hit is not None:
-        from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+    return _get_or_transfer(panel, mesh, compute_dtype, raw, lane_pad).dev
 
-        REUSE_COUNTERS.panel_cache_hits += 1
-        return hit
-    from lfm_quant_tpu.parallel.mesh import replicated
 
-    sharding = replicated(mesh) if mesh is not None else None
-    dev = device_panel(panel, sharding, compute_dtype=compute_dtype,
-                       raw=raw, lane_pad=lane_pad)
-    _PANEL_CACHE[key] = dev
-    # Evict on panel gc: entries must never outlive their panel (id()
-    # reuse would silently serve another panel's bytes).
-    weakref.finalize(panel, _PANEL_CACHE.pop, key, None)
-    return dev
+@contextlib.contextmanager
+def lease_device_panel(panel: Panel, mesh=None, compute_dtype=None,
+                       raw: bool = False, lane_pad: bool = False):
+    """:func:`cached_device_panel` with the entry PINNED for the block:
+    the serving dispatch path wraps every scoring dispatch in a lease so
+    a concurrent :func:`invalidate_panel` (monthly data arrival, zoo
+    eviction) can never finalize the entry mid-dispatch. Yields the same
+    dev dict ``cached_device_panel`` would return."""
+    entry = _get_or_transfer(panel, mesh, compute_dtype, raw, lane_pad)
+    with _PANEL_LOCK:
+        entry.leases += 1
+    try:
+        yield entry.dev
+    finally:
+        with _PANEL_LOCK:
+            entry.leases -= 1
+            if entry.doomed and entry.leases == 0:
+                # Last reader of an invalidated entry: the deferred drop
+                # (the entry left the cache at invalidation; its arrays
+                # are freed by GC once this reference dies). Counted so
+                # the regression tests can assert the deferral happened.
+                from lfm_quant_tpu.utils.telemetry import COUNTERS
+
+                COUNTERS.bump("panel_deferred_drops")
 
 
 def invalidate_panel(panel: Panel) -> int:
@@ -546,12 +666,19 @@ def invalidate_panel(panel: Panel) -> int:
     — the TRAINING residency cache here AND the backtest engine's
     scoring-panel cache (returns/targets/tradeability;
     backtest/jax_engine.py), so one call covers every device copy a
-    mutated-in-place panel could go stale in. Returns the number of
+    mutated-in-place panel could go stale in. Entries with in-flight
+    leases are marked doomed and finalized at the LAST release instead
+    of immediately (refcount-safe: a live scoring dispatch keeps its
+    arrays); either way the entry leaves the cache NOW, so the next
+    reader re-transfers fresh bytes. Returns the number of
     training-cache entries dropped (the reuse tests' counter; scoring
     entries are dropped on top)."""
-    doomed = [k for k in _PANEL_CACHE if k[0] == id(panel)]
-    for k in doomed:
-        _PANEL_CACHE.pop(k, None)
+    with _PANEL_LOCK:
+        doomed = [k for k in _PANEL_CACHE if k[0] == id(panel)]
+        for k in doomed:
+            entry = _PANEL_CACHE.pop(k)
+            if entry.leases > 0:
+                entry.doomed = True
     try:
         from lfm_quant_tpu.backtest.jax_engine import invalidate_score_panel
 
@@ -563,7 +690,8 @@ def invalidate_panel(panel: Panel) -> int:
 
 def clear_panel_cache() -> None:
     """Drop all cached device panels (tests / memory pressure)."""
-    _PANEL_CACHE.clear()
+    with _PANEL_LOCK:
+        _PANEL_CACHE.clear()
 
 
 def _slice_windows(rows, vrows, time_idx, window: int):

@@ -1,0 +1,36 @@
+"""Always-on scoring service (L7): the batch stack turned online.
+
+The walk-forward stack trains and scores as batch programs; this
+package serves the same compiled programs to live traffic:
+
+  buckets.py — request-shape quantization (padded cross-section / row
+               buckets folded into the program-cache key, Khomenko-style
+               sequence bucketing) so arbitrary queries never re-trace
+  zoo.py     — HBM-resident model zoo: (universe × generation) entries
+               through the PR 1 program/panel caches, refcount-safe LRU
+               eviction and atomic generation swap
+  batcher.py — micro-batcher coalescing concurrent queries into one
+               bucketed dispatch of the compiled scoring core, with
+               per-request latency spans + queue/occupancy counters
+               through the PR 4 telemetry registry
+  service.py — the front-end: register / warmup / score / submit /
+               refresh (warm single-fold retrain + swap) / stats
+  stats.py   — pure-python latency percentiles shared with bench and
+               mirrored in scripts/trace_report.py
+
+Entry point: ``serve.py`` at the repo root. Knobs: ``LFM_SERVE_ZOO``,
+``LFM_SERVE_MAX_ROWS``, ``LFM_SERVE_MAX_WAIT_MS``.
+"""
+
+from lfm_quant_tpu.serve.batcher import MicroBatcher, ScoreResponse
+from lfm_quant_tpu.serve.service import ScoringService
+from lfm_quant_tpu.serve.zoo import ModelZoo, ServePrograms, ZooEntry
+
+__all__ = [
+    "MicroBatcher",
+    "ModelZoo",
+    "ScoreResponse",
+    "ScoringService",
+    "ServePrograms",
+    "ZooEntry",
+]

@@ -1,0 +1,313 @@
+"""Request micro-batcher: coalesce concurrent queries into one dispatch.
+
+The serving hot path is the PR 2 lesson applied to request traffic:
+months are independent given the params, so N concurrent requests for
+the same universe are ONE ``[rows, width]`` scoring dispatch, not N
+serial ones — each row is one request's padded cross-section, exactly
+the ``[M, bf]`` layout the batch eval sweep dispatches. The batcher
+thread pops the queue, coalesces same-(universe, width-bucket) requests
+for at most ``max_wait_ms`` (or until ``max_rows``), pads to the
+request-shape bucket (``serve/buckets.py``) and dispatches through the
+zoo entry's cached bucket program. Steady state therefore pays zero jit
+traces (every bucket was warmed), zero panel H2D (the panel is
+resident), and one small H2D (int32 indices + f32 weights) + one D2H
+(f32 scores) per BATCH.
+
+Observability (PR 4 registry): every request is an async
+``serve_request`` span begun at submit and ended at completion carrying
+``latency_ms`` (the number ``stats()``/bench/trace_report all roll up —
+one measurement, three consumers, no drift); every dispatch is a sync
+``serve_batch`` span carrying rows/occupancy/queue depth; counters
+``serve_requests`` / ``serve_batches`` / ``serve_rows`` /
+``serve_rows_real`` / ``serve_queue_peak`` feed the run record.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from lfm_quant_tpu.serve.buckets import bucket_rows, bucket_width
+from lfm_quant_tpu.serve.zoo import ModelZoo
+from lfm_quant_tpu.utils import telemetry
+
+
+class ScoreResponse(NamedTuple):
+    """One served query: the month's eligible firms and their scores.
+
+    ``firm_idx`` are panel rows (int32) in pool order; ``scores`` the
+    matching float32 forecasts — the ranking signal a client trades on.
+    ``generation`` tags which zoo generation served it (every response
+    is entirely one generation's — the no-torn-request contract).
+    """
+
+    universe: str
+    month: int
+    generation: int
+    firm_idx: np.ndarray
+    scores: np.ndarray
+    latency_ms: float
+
+
+class _Request:
+    __slots__ = ("universe", "month", "width", "future", "t_submit",
+                 "span")
+
+    def __init__(self, universe: str, month: int, width: int,
+                 future: Future, span):
+        self.universe = universe
+        self.month = month
+        self.width = width
+        self.future = future
+        self.t_submit = time.perf_counter()
+        self.span = span
+
+
+class MicroBatcher:
+    """The queue + batcher thread. One instance per ScoringService."""
+
+    def __init__(self, zoo: ModelZoo, max_rows: int, max_wait_ms: float,
+                 latency_window: int = 65536):
+        self.zoo = zoo
+        self.max_rows = max(1, int(max_rows))
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self._queue: "deque[_Request]" = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._stats_lock = threading.Lock()
+        self._lat_ms: "deque[float]" = deque(maxlen=max(1, latency_window))
+        self._rows = 0
+        self._rows_real = 0
+        self._batches = 0
+        self._requests = 0
+        self._errors = 0
+        self._rejects = 0
+        self._queue_peak = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-batcher", daemon=True)
+        self._thread.start()
+
+    # ---- client side -------------------------------------------------
+
+    def submit(self, universe: str, month: int) -> Future:
+        """Enqueue one scoring query; the Future resolves to a
+        :class:`ScoreResponse` (or raises the routing/validation error).
+        Validation that only needs the ROUTING table happens here so a
+        bad request fails fast without occupying the batcher."""
+        future: Future = Future()
+        try:
+            entry = self.zoo.current(universe)  # KeyError → unregistered
+            t = entry.month_col(month)
+            n_firms = entry.pool_size(t)  # memoized — no pool copy here
+            width = bucket_width(n_firms)
+        except Exception as e:  # noqa: BLE001 — routed to the caller
+            future.set_exception(e)
+            return future
+        span = telemetry.begin_async("serve_request", cat="serve",
+                                     universe=universe, month=int(month),
+                                     n_firms=int(n_firms))
+        req = _Request(universe, int(month), width, future, span)
+        with self._cv:
+            if self._stop:
+                span.end(error="closed")
+                future.set_exception(
+                    RuntimeError("scoring service is closed"))
+                return future
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cv.notify()
+        telemetry.COUNTERS.bump("serve_requests")
+        telemetry.COUNTERS.peak("serve_queue_peak", depth)
+        with self._stats_lock:
+            if depth > self._queue_peak:
+                self._queue_peak = depth
+        return future
+
+    # ---- batcher thread ----------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            try:
+                self._dispatch(batch)
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                with self._stats_lock:
+                    self._errors += 1
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                    r.span.end(error=type(e).__name__)
+
+    def _next_batch(self) -> Optional[List[_Request]]:
+        """Pop the head request, then coalesce same-(universe, width)
+        requests until ``max_rows`` or the ``max_wait_ms`` window closes.
+        Non-matching requests stay queued in order for the next batch."""
+        with self._cv:
+            while not self._queue:
+                if self._stop:
+                    return None
+                self._cv.wait(0.05)
+            first = self._queue.popleft()
+            key = (first.universe, first.width)
+            batch = [first]
+            deadline = time.perf_counter() + self.max_wait_s
+            while len(batch) < self.max_rows:
+                matched = False
+                for i, r in enumerate(self._queue):
+                    if (r.universe, r.width) == key:
+                        del self._queue[i]
+                        batch.append(r)
+                        matched = True
+                        break
+                if matched:
+                    continue
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or self._stop:
+                    break
+                self._cv.wait(remaining)
+                if not self._queue and self._stop:
+                    break
+            telemetry.COUNTERS.set("serve_queue_depth", len(self._queue))
+            return batch
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        universe = batch[0].universe
+        with self.zoo.lease(universe) as entry:
+            # Per-request validation against the LEASED entry: a request
+            # validated at submit against an older generation can be
+            # stale by dispatch (a refresh changed the serveable set).
+            # Only the stale request fails — its coalesced neighbors
+            # must not be poisoned by someone else's KeyError.
+            live: List[_Request] = []
+            pools = []
+            for r in batch:
+                try:
+                    t = entry.month_col(r.month)
+                    pool = entry.pool(t)
+                except Exception as e:  # noqa: BLE001 — per-request fate
+                    r.span.end(error=type(e).__name__)
+                    r.future.set_exception(e)
+                    with self._stats_lock:
+                        self._rejects += 1
+                    continue
+                live.append(r)
+                pools.append((t, pool))
+            batch = live
+            if not batch:
+                return
+            rows = bucket_rows(len(batch), self.max_rows)
+            # Re-derive the width from the LEASED entry's pools — the
+            # truth this response is built from. Deliberately NOT
+            # max()ed with the submit-time bucket: a generation swap
+            # between submit and dispatch can change pool sizes either
+            # way, and only the width derived from the leased pools is
+            # guaranteed to be in the LEASED entry's warmed ladder (a
+            # stale submit-time width could force a compile on the
+            # serving hot path).
+            width = bucket_width(max(p.size for _, p in pools))
+            fi = np.zeros((rows, width), np.int32)
+            ti = np.zeros((rows,), np.int32)
+            w = np.zeros((rows, width), np.float32)
+            for i, (t, pool) in enumerate(pools):
+                fi[i, :pool.size] = pool
+                fi[i, pool.size:] = pool[-1] if pool.size else 0
+                ti[i] = t
+                w[i, :pool.size] = 1.0
+            # Padded rows repeat row 0 at weight 0 (same scheme as the
+            # eval sweep's thin dates — shapes static, outputs masked).
+            for i in range(len(batch), rows):
+                fi[i], ti[i] = fi[0], ti[0]
+            occupancy = len(batch) / rows
+            with telemetry.span("serve_batch", cat="serve",
+                                universe=universe, generation=entry.generation,
+                                rows=rows, rows_real=len(batch),
+                                width=width, occupancy=round(occupancy, 4),
+                                queue_depth=len(self._queue)):
+                with entry.lease_panel() as dev:
+                    programs = entry.programs_for((rows, width))
+                    out = np.asarray(programs(entry.params, dev, fi, ti, w))
+            t_done = time.perf_counter()
+            gen = entry.generation
+        lats = []
+        for i, r in enumerate(batch):
+            pool = pools[i][1]
+            lat = round((t_done - r.t_submit) * 1e3, 3)
+            lats.append(lat)
+            r.span.end(latency_ms=lat, generation=gen)
+            r.future.set_result(ScoreResponse(
+                universe=universe, month=r.month, generation=gen,
+                firm_idx=pool, scores=out[i, :pool.size].copy(),
+                latency_ms=lat))
+        telemetry.COUNTERS.bump("serve_batches")
+        telemetry.COUNTERS.bump("serve_rows", rows)
+        telemetry.COUNTERS.bump("serve_rows_real", len(batch))
+        with self._stats_lock:
+            self._lat_ms.extend(lats)
+            self._rows += rows
+            self._rows_real += len(batch)
+            self._batches += 1
+            self._requests += len(batch)
+
+    # ---- stats / lifecycle -------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        from lfm_quant_tpu.serve.stats import latency_summary
+
+        with self._stats_lock:
+            lat = list(self._lat_ms)
+            rows, real = self._rows, self._rows_real
+            out: Dict[str, Any] = {
+                "completed": self._requests,
+                "batches": self._batches,
+                "dispatch_errors": self._errors,
+                "rejected": self._rejects,
+                # THIS batcher's peak (the process-global
+                # serve_queue_peak counter spans every instance and is
+                # never reset — it feeds the run record, not stats).
+                "queue_peak": self._queue_peak,
+            }
+        out.update(latency_summary(lat))
+        # The rolling window bounds memory on long-lived services; past
+        # its size the percentiles cover only the newest requests while
+        # trace_report covers every span — the flag marks when the
+        # "stats == trace_report" cross-check stops being exact.
+        out["latency_truncated"] = out["completed"] > len(lat)
+        out["mean_occupancy"] = round(real / rows, 4) if rows else None
+        out["rows"] = rows
+        out["rows_real"] = real
+        return out
+
+    def reset_stats(self) -> None:
+        """Zero the rolling stats window (latencies, occupancy, peaks) —
+        bench draws the line between warmup and the measured steady
+        state with this, so the reported percentiles cover exactly the
+        timed window."""
+        with self._stats_lock:
+            self._lat_ms.clear()
+            self._rows = self._rows_real = 0
+            self._batches = self._requests = 0
+            self._errors = self._rejects = 0
+            self._queue_peak = 0
+
+    def close(self) -> None:
+        """Stop the batcher thread; drain the queue by failing pending
+        requests loudly (a silent drop would hang clients forever)."""
+        with self._cv:
+            self._stop = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        for r in pending:
+            if not r.future.done():
+                r.future.set_exception(
+                    RuntimeError("scoring service closed with the "
+                                 "request still queued"))
+            r.span.end(error="closed")
+        self._thread.join(timeout=10.0)

@@ -1,0 +1,92 @@
+"""Request-shape buckets: quantize arbitrary queries to compiled shapes.
+
+A scoring request's natural shape is ragged twice over — the month's
+eligible cross-section (hundreds to thousands of firms, different every
+month) and the number of requests the micro-batcher happens to coalesce.
+Dispatching those raw shapes into jit would re-trace (and XLA-recompile)
+on nearly every query. The fix is the sequence-bucketing idea of
+Khomenko et al. 1708.05604 applied to the serving path: round both axes
+UP to a power-of-two bucket, pad with weight-0 slots (exactly the
+padding discipline the eval sweep already uses), and fold the bucket
+into the program-cache key (``train/reuse.py serve_program_key``). The
+bucket ladder is finite and known at warmup, so every program the
+service can ever dispatch is compiled before the first real request —
+steady state pays ZERO jit traces by construction, measured by the
+``reuse`` counters. The third ragged axis — the model's lookback window
+— is a per-universe constant and already lives in the inner trainer
+program key (``cfg.data.window``), so distinct lookbacks are distinct
+compiled programs the same way.
+
+Padding waste is bounded by construction: a power-of-two ladder wastes
+< 2× slots worst-case, and weight-0 slots cost only FLOPs, not
+correctness (the forward masks them; responses slice them off).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence, Tuple
+
+#: Smallest cross-section bucket (sublane-tiling floor, matching the
+#: sampler's minimum pad multiple in data/windows.py).
+MIN_WIDTH = 8
+
+
+def next_pow2(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor)."""
+    n = max(int(n), floor)
+    p = 1 << (n - 1).bit_length()
+    return p
+
+
+def bucket_width(n_firms: int) -> int:
+    """Cross-section bucket for a month's eligible pool: next power of
+    two, floored at :data:`MIN_WIDTH`."""
+    if n_firms < 1:
+        raise ValueError(f"bucket_width needs >= 1 firm, got {n_firms}")
+    return next_pow2(n_firms, MIN_WIDTH)
+
+
+def bucket_rows(n_requests: int, max_rows: int) -> int:
+    """Row (coalesced-request) bucket: next power of two, capped at the
+    batcher's ``max_rows`` (the cap is itself a ladder member)."""
+    if n_requests < 1:
+        raise ValueError(f"bucket_rows needs >= 1 request, got {n_requests}")
+    return min(next_pow2(n_requests), next_pow2(max_rows))
+
+
+def rows_ladder(max_rows: int) -> List[int]:
+    """Every row bucket the batcher can produce: 1, 2, 4, … max bucket."""
+    top = next_pow2(max_rows)
+    out, r = [], 1
+    while r <= top:
+        out.append(r)
+        r <<= 1
+    return out
+
+
+def width_ladder(pool_sizes: Sequence[int]) -> List[int]:
+    """The distinct cross-section buckets a universe's serveable months
+    occupy — what warmup must pre-trace (sorted ascending)."""
+    return sorted({bucket_width(int(n)) for n in pool_sizes if n > 0})
+
+
+def max_rows_default() -> int:
+    """``LFM_SERVE_MAX_ROWS``: the micro-batch row cap (default 8)."""
+    return max(1, int(os.environ.get("LFM_SERVE_MAX_ROWS", "8")))
+
+
+def max_wait_ms_default() -> float:
+    """``LFM_SERVE_MAX_WAIT_MS``: how long the batcher holds a batch
+    open for more same-bucket requests (default 2 ms — latency floor
+    traded against occupancy)."""
+    return float(os.environ.get("LFM_SERVE_MAX_WAIT_MS", "2"))
+
+
+def zoo_capacity_default() -> int:
+    """``LFM_SERVE_ZOO``: resident (universe) entries before LRU
+    eviction (default 8)."""
+    return max(1, int(os.environ.get("LFM_SERVE_ZOO", "8")))
+
+
+BucketKey = Tuple[int, int]  # (rows, cross-section width)

@@ -1,0 +1,183 @@
+"""The always-on scoring service: zoo + batcher + refresh, one object.
+
+``ScoringService`` is the persistent serving front-end over the batch
+stack: register a fitted trainer per universe, ``warmup()`` pre-traces
+every request-shape bucket the universe can produce, then ``score()``/
+``submit()`` serve arbitrary month queries with zero jit traces and
+zero panel H2D in steady state (the ``reuse``-counter contract extended
+from walk-forward folds to serving traffic). Monthly data arrival is an
+**incremental refresh**: rebuild the trainer on the advanced rolling
+split (a program-cache HIT — same-shape folds share executables),
+warm-start-fit from the served generation's params (the PR 1 warm-start
++ PR 3 pipelined fit; a one-fold "stack" IS the sequential fit — the
+PR 5 stacked driver needs ≥ 2 folds and remains the batch-sweep tool),
+and atomically publish the new generation — requests in flight finish
+on the old generation, new ones route to the new, nothing is dropped
+or torn, and nothing recompiles.
+
+Donation safety (load-bearing): the refresh fit's multi-step programs
+DONATE their TrainState, so the warm start must feed a COPY of the
+served params — handing the live generation's buffers to a donating
+dispatch would delete them under in-flight scoring traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from lfm_quant_tpu.serve import buckets
+from lfm_quant_tpu.serve.batcher import MicroBatcher, ScoreResponse
+from lfm_quant_tpu.serve.zoo import ModelZoo, ZooEntry
+from lfm_quant_tpu.utils import telemetry
+
+
+class ScoringService:
+    """One process-wide serving object (the serve.py entry point owns
+    one; tests construct their own)."""
+
+    def __init__(self, zoo_capacity: Optional[int] = None,
+                 max_rows: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None):
+        self.zoo = ModelZoo(zoo_capacity or buckets.zoo_capacity_default())
+        self.max_rows = max_rows or buckets.max_rows_default()
+        self.batcher = MicroBatcher(
+            self.zoo, self.max_rows,
+            buckets.max_wait_ms_default() if max_wait_ms is None
+            else max_wait_ms)
+        self._refresh_lock = threading.Lock()
+
+    # ---- registration / warmup --------------------------------------
+
+    def register(self, universe: str, trainer: Any, *,
+                 warm: bool = True) -> ZooEntry:
+        """Make ``trainer`` (fitted; its splits' panel is the universe)
+        servable as generation 0 — or the next generation if the
+        universe is already registered. ``warm=True`` pre-traces every
+        (rows, width) bucket so the first real request already runs
+        compile-free."""
+        donor = None
+        try:
+            donor = self.zoo.current(universe)
+            gen = donor.generation + 1
+        except KeyError:
+            gen = 0
+        entry = ZooEntry(universe, gen, trainer)
+        if donor is not None:
+            entry.adopt_programs(donor)
+        if warm:
+            self.warmup_entry(entry)
+        self.zoo.publish(entry)
+        return entry
+
+    def warmup_entry(self, entry: ZooEntry) -> int:
+        """Dispatch one zero-weight batch per (rows, width) bucket the
+        entry can produce, compiling each bucket program exactly once
+        (or zero times when a prior generation/universe with the same
+        geometry already did). Returns the bucket count."""
+        widths = entry.widths()
+        months = entry._sampler.months_with_anchors()
+        if not widths or months.size == 0:
+            raise ValueError(
+                f"universe {entry.universe!r}: no serveable months (no "
+                "month has an eligible cross-section under this panel/"
+                "window) — nothing to warm, nothing to serve")
+        ladder = buckets.rows_ladder(self.max_rows)
+        t0 = int(months[0])
+        with telemetry.span("serve_warmup", cat="serve",
+                            universe=entry.universe,
+                            buckets=len(widths) * len(ladder)):
+            with entry.lease_panel() as dev:
+                for width in widths:
+                    for rows in ladder:
+                        fi = np.zeros((rows, width), np.int32)
+                        ti = np.full((rows,), t0, np.int32)
+                        w = np.zeros((rows, width), np.float32)
+                        np.asarray(entry.programs_for((rows, width))(
+                            entry.params, dev, fi, ti, w))
+        return len(widths) * len(ladder)
+
+    # ---- query path --------------------------------------------------
+
+    def submit(self, universe: str, month: int) -> Future:
+        """Async query: Future of a :class:`ScoreResponse`."""
+        return self.batcher.submit(universe, month)
+
+    def score(self, universe: str, month: int,
+              timeout: Optional[float] = 60.0) -> ScoreResponse:
+        """Sync query: the month's scored cross-section."""
+        return self.batcher.submit(universe, month).result(timeout=timeout)
+
+    def serveable_months(self, universe: str) -> List[int]:
+        return self.zoo.current(universe).serveable_months()
+
+    # ---- incremental refresh -----------------------------------------
+
+    def refresh(self, universe: str, splits: Any,
+                epochs: Optional[int] = None) -> ZooEntry:
+        """Monthly data arrival: warm single-fold retrain + atomic swap.
+
+        Builds a FRESH trainer on ``splits`` (the advanced rolling
+        boundaries — with an unchanged shape this is a program-cache
+        hit: the served generation's executables, zero traces), fits it
+        warm-started from a COPY of the served params (copy because the
+        fit donates its state — see module docstring), warms the new
+        entry's buckets (no-ops on the shared warm programs) and
+        publishes it. Serving continues uninterrupted throughout: the
+        old generation handles traffic until the publish, then drains.
+        Returns the new entry.
+        """
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        with self._refresh_lock:
+            cur = self.zoo.current(universe)
+            cfg = cur.cfg
+            if epochs is not None:
+                cfg = dataclasses.replace(
+                    cfg, optim=dataclasses.replace(cfg.optim, epochs=epochs))
+            with telemetry.span("serve_refresh", cat="serve",
+                                universe=universe,
+                                generation=cur.generation + 1) as sp:
+                from lfm_quant_tpu.train import reuse
+
+                # Re-seed the served generation's trainer bundle before
+                # constructing the new trainer: if a crowded LRU evicted
+                # the key, re-admission through the existing bundle
+                # (builder returns it — no rebuild) keeps the refresh
+                # fit on the warm executables instead of re-tracing.
+                reuse.get_programs(cur.trainer.program_key,
+                                   lambda: cur.trainer.programs)
+                trainer = type(cur.trainer)(cfg, splits, run_dir=None)
+                init = jax.tree.map(jnp.copy, cur.params)
+                fit = trainer.fit(init_params=init)
+                sp.set(epochs_run=fit["epochs_run"],
+                       best_val_ic=fit["best_val_ic"])
+                entry = ZooEntry(universe, cur.generation + 1, trainer)
+                entry.adopt_programs(cur)
+                self.warmup_entry(entry)
+                self.zoo.publish(entry)
+            return entry
+
+    # ---- observability / lifecycle -----------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """The serving rollup: batcher latency/occupancy plus zoo state.
+        ``p50_ms``/``p99_ms`` come from the same per-request
+        ``latency_ms`` values the ``serve_request`` spans carry, so
+        ``scripts/trace_report.py`` reproduces them exactly from a run
+        dir (the bench cross-check contract)."""
+        out = self.batcher.stats()
+        out["universes"] = {
+            u: self.zoo.generation(u) for u in self.zoo.universes()}
+        out["zoo_size"] = len(self.zoo)
+        out["zoo_capacity"] = self.zoo.capacity
+        return out
+
+    def close(self) -> None:
+        self.batcher.close()

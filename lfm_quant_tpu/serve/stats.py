@@ -1,0 +1,52 @@
+"""Latency/occupancy statistics for the scoring service — pure python.
+
+One percentile implementation, used by ``ScoringService.stats()`` and
+``bench.py serve``, and duplicated VERBATIM in ``scripts/trace_report.py``
+(which must stay importable with no package/jax dependency — it runs as
+a bare script from any host). The serve test lane cross-checks the two
+against each other on the same run dir, so they cannot drift silently.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Linear-interpolation percentile (numpy's default rule) over a
+    small sample; None on empty input. ``q`` in [0, 100]."""
+    if not values:
+        return None
+    v = sorted(values)
+    k = (len(v) - 1) * q / 100.0
+    f, c = math.floor(k), math.ceil(k)
+    if f == c:
+        return float(v[int(k)])
+    return float(v[f] * (c - k) + v[c] * (k - f))
+
+
+def latency_summary(lat_ms: Sequence[float]) -> dict:
+    """The serve latency rollup both bench and stats() report."""
+    return {
+        "requests": len(lat_ms),
+        "p50_ms": percentile(lat_ms, 50.0),
+        "p99_ms": percentile(lat_ms, 99.0),
+        "max_ms": max(lat_ms) if lat_ms else None,
+    }
+
+
+def load_trace_report(repo_root: str):
+    """Import ``scripts/trace_report.py`` as a module (it is a bare
+    script, not a package member, by design — no jax/package imports).
+    One loader shared by ``bench.py serve`` and the serve test lane so
+    a relocation of the script cannot silently split the cross-check."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "lfm_trace_report", os.path.join(repo_root, "scripts",
+                                         "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

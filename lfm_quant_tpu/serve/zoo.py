@@ -1,0 +1,313 @@
+"""HBM-resident model zoo: many (universe × generation) served models.
+
+Each :class:`ZooEntry` is one servable model generation: a fitted
+``Trainer`` whose compiled programs and device-resident panel came
+through the PR 1 reuse caches (``train/reuse.py`` program cache,
+``data/windows.py cached_device_panel``), plus the serving-side pools
+(which firms are scoreable for which months, ``require_target=False``
+so LIVE months — the ones a production user actually trades on — are
+servable) and the per-bucket scoring programs.
+
+Lifecycle invariants, all lock-guarded and refcount-safe:
+
+* **Lease, don't grab** — the batcher scores through ``zoo.lease()``,
+  which pins the entry for the dispatch. Publish/evict NEVER tears a
+  leased entry: it is atomically unlinked from the routing table (new
+  requests route to the new generation / miss) and decommissioned only
+  when the last lease drains.
+* **Atomic generation swap** — :meth:`ModelZoo.publish` replaces the
+  current generation in one lock region; every request is served
+  entirely by one generation (no torn reads), and the old generation's
+  HBM is reclaimed once its in-flight dispatches finish.
+* **LRU eviction** — capacity is counted in resident universes
+  (``LFM_SERVE_ZOO``); the least-recently-leased universe is evicted
+  when a registration overflows it. Eviction releases the panel's
+  device residency through ``invalidate_panel`` — whose own
+  refcount/deferred-drop machinery (``data/windows.py``) makes that
+  safe under an in-flight dispatch — unless another resident entry
+  still shares the panel object.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from lfm_quant_tpu.serve.buckets import BucketKey, width_ladder
+from lfm_quant_tpu.utils import telemetry
+
+
+class ServePrograms:
+    """The compiled scoring program of ONE request-shape bucket, cached
+    in the cross-fold program cache under ``reuse.serve_program_key``:
+    a jitted forecast-only forward (the inner ``TrainerPrograms``
+    impl with per-month metrics compiled out) plus the weight mask that
+    zeroes padded slots — the same masking ``_aggregate_modes`` applies,
+    so served scores are bit-identical to the batch scoring path's.
+    Holds only the inner program bundle and the bucket geometry, no
+    panel or state (the same lightweight-entry invariant every cached
+    bundle keeps)."""
+
+    def __init__(self, inner: Any, bucket: BucketKey):
+        import jax.numpy as jnp
+
+        from lfm_quant_tpu.train.reuse import ledger_jit
+
+        self.inner = inner
+        self.bucket = bucket
+
+        def score(params, dev, fi, ti, w):
+            pred, _, _ = inner._forward_impl(params, dev, fi, ti, w,
+                                             scores_only=True)
+            return jnp.where(w > 0, pred.astype(jnp.float32), 0.0)
+
+        rows, width = bucket
+        self._jit_score = ledger_jit(f"serve_score_r{rows}x{width}", score)
+
+    def __call__(self, params, dev, fi, ti, w):
+        return self._jit_score(params, dev, fi, ti, w)
+
+
+class ZooEntry:
+    """One servable (universe, generation) model resident in HBM."""
+
+    def __init__(self, universe: str, generation: int, trainer: Any):
+        import jax.numpy as jnp
+
+        from lfm_quant_tpu.data.windows import DateBatchSampler
+
+        if trainer.state is None:
+            raise ValueError(
+                f"universe {universe!r}: trainer has no state — fit() it "
+                "(or set trainer.state = trainer.init_state()) before "
+                "registering; the zoo serves params, it does not train")
+        self.universe = universe
+        self.generation = int(generation)
+        self.trainer = trainer
+        self.cfg = trainer.cfg
+        self.panel = trainer.splits.panel
+        # Tagged routing key: distinct (universe, generation) pairs can
+        # never collide by construction (no string concatenation).
+        self.key = ("zoo", ("universe", universe),
+                    ("generation", self.generation))
+        d = self.cfg.data
+        # Serving pools over the WHOLE panel, live months included: the
+        # last `horizon` months have no realized target by construction
+        # and are exactly what production queries rank.
+        self._sampler = DateBatchSampler(
+            self.panel, d.window, 1, d.firms_per_date, seed=0,
+            min_valid_months=d.min_valid_months, min_cross_section=1,
+            require_target=False)
+        months = self._sampler.months_with_anchors()
+        self._month_index: Dict[int, int] = {
+            int(self.panel.dates[t]): int(t) for t in months}
+        self._pool_sizes = {int(t): self._sampler.cross_section(int(t)).size
+                            for t in months}
+        self._compute_dtype = jnp.bfloat16 if self.cfg.model.bf16 else None
+        self._lane_pad = trainer._gather_impl == "pallas"
+        # Per-bucket scoring programs, memoized HERE as well as in the
+        # reuse LRU: an entry must keep its executables warm even if a
+        # busy cache evicts the serve keys (evicted bundles keep working
+        # for holders of a reference — train/reuse.py contract).
+        self._programs: Dict[BucketKey, ServePrograms] = {}
+        # Zoo bookkeeping (guarded by the zoo's lock).
+        self.refs = 0
+        self.doomed = False
+
+    # ---- serveable geometry -----------------------------------------
+
+    def serveable_months(self) -> List[int]:
+        """YYYYMM months with a non-empty scoreable cross-section."""
+        return sorted(self._month_index)
+
+    def month_col(self, yyyymm: int) -> int:
+        """Panel column of a serveable YYYYMM month (KeyError detail
+        names the universe — the error a client sees)."""
+        try:
+            return self._month_index[int(yyyymm)]
+        except KeyError:
+            raise KeyError(
+                f"month {yyyymm} is not serveable for universe "
+                f"{self.universe!r} (no eligible cross-section)") from None
+
+    def pool(self, t: int) -> np.ndarray:
+        return self._sampler.cross_section(t)
+
+    def pool_size(self, t: int) -> int:
+        """Memoized pool size — the submit hot path only needs the
+        width bucket, not an O(n_firms) pool copy per request."""
+        return self._pool_sizes.get(int(t), 0)
+
+    def widths(self) -> List[int]:
+        """Every cross-section bucket this universe's months occupy."""
+        return width_ladder(self._pool_sizes.values())
+
+    # ---- dispatch resources -----------------------------------------
+
+    def lease_panel(self):
+        """Pin the entry's device panel for a dispatch (refcount-safe
+        against a concurrent invalidate — data/windows.py)."""
+        from lfm_quant_tpu.data.windows import lease_device_panel
+
+        return lease_device_panel(
+            self.panel, self.trainer.mesh,
+            compute_dtype=self._compute_dtype, raw=False,
+            lane_pad=self._lane_pad)
+
+    def programs_for(self, bucket: BucketKey) -> ServePrograms:
+        """The bucket's scoring program, through the reuse program cache
+        (warm generations of the same universe geometry share it)."""
+        sp = self._programs.get(bucket)
+        if sp is None:
+            from lfm_quant_tpu.train import reuse
+
+            inner = self.trainer.programs
+            sp = reuse.get_programs(
+                reuse.serve_program_key(self.trainer.program_key, bucket),
+                lambda: ServePrograms(inner, bucket))
+            self._programs[bucket] = sp
+        return sp
+
+    def adopt_programs(self, donor: "ZooEntry") -> None:
+        """Inherit a predecessor generation's warm bucket programs when
+        the inner program key is unchanged (the refresh path). The
+        donor's programs are RE-SEEDED into the reuse cache through
+        ``get_programs`` with a builder that returns the existing
+        bundle — so even if LRU pressure (many universes × buckets)
+        evicted the serve keys since the donor warmed them,
+        re-admission re-caches the compiled objects instead of
+        rebuilding fresh jit wrappers that would re-trace on first
+        dispatch. This is what keeps a refresh recompile-free under a
+        full zoo, not just an idle one."""
+        if donor.trainer.program_key != self.trainer.program_key:
+            return  # changed geometry: genuinely new programs
+        from lfm_quant_tpu.train import reuse
+
+        for bucket, sp in donor._programs.items():
+            self._programs[bucket] = reuse.get_programs(
+                reuse.serve_program_key(self.trainer.program_key, bucket),
+                lambda sp=sp: sp)
+
+    @property
+    def params(self):
+        return self.trainer.state.params
+
+
+class ModelZoo:
+    """The routing table: universe name → current resident generation."""
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, ZooEntry]" = OrderedDict()
+
+    # ---- introspection ----------------------------------------------
+
+    def universes(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def current(self, universe: str) -> ZooEntry:
+        with self._lock:
+            entry = self._entries.get(universe)
+            if entry is None:
+                raise KeyError(
+                    f"universe {universe!r} is not registered "
+                    f"(resident: {list(self._entries)})")
+            return entry
+
+    def generation(self, universe: str) -> int:
+        return self.current(universe).generation
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ---- lease / publish / evict ------------------------------------
+
+    @contextlib.contextmanager
+    def lease(self, universe: str):
+        """Pin the universe's CURRENT entry for one dispatch. The entry
+        stays fully servable for the whole block even if a publish or
+        eviction unlinks it concurrently — decommission waits for the
+        last lease."""
+        with self._lock:
+            entry = self._entries.get(universe)
+            if entry is None:
+                raise KeyError(f"universe {universe!r} is not registered "
+                               f"(resident: {list(self._entries)})")
+            self._entries.move_to_end(universe)  # LRU recency
+            entry.refs += 1
+        try:
+            yield entry
+        finally:
+            with self._lock:
+                entry.refs -= 1
+                dead = entry.doomed and entry.refs == 0
+            if dead:
+                self._decommission(entry)
+
+    def publish(self, entry: ZooEntry) -> Optional[ZooEntry]:
+        """Atomically make ``entry`` the served generation for its
+        universe. Returns the replaced entry (already unlinked; its HBM
+        drains when its last lease does). Registering a NEW universe
+        over capacity LRU-evicts the least-recently-leased one."""
+        evicted: List[ZooEntry] = []
+        with self._lock:
+            old = self._entries.get(entry.universe)
+            if old is not None and old.generation >= entry.generation:
+                raise ValueError(
+                    f"universe {entry.universe!r}: generation "
+                    f"{entry.generation} does not advance the served "
+                    f"generation {old.generation} — refresh must publish "
+                    "monotonically")
+            self._entries[entry.universe] = entry
+            self._entries.move_to_end(entry.universe)
+            if old is not None:
+                old.doomed = True
+                if old.refs == 0:
+                    evicted.append(old)
+            while len(self._entries) > self.capacity:
+                _, lru = self._entries.popitem(last=False)
+                telemetry.COUNTERS.bump("serve_zoo_evictions")
+                lru.doomed = True
+                if lru.refs == 0:
+                    evicted.append(lru)
+        for e in evicted:
+            self._decommission(e)
+        if old is not None:
+            telemetry.instant("zoo_swap", cat="serve",
+                              universe=entry.universe,
+                              generation=entry.generation)
+        return old
+
+    def drop(self, universe: str) -> None:
+        """Explicitly unregister a universe (tests/operator)."""
+        with self._lock:
+            entry = self._entries.pop(universe, None)
+            if entry is None:
+                return
+            entry.doomed = True
+            dead = entry.refs == 0
+        if dead:
+            self._decommission(entry)
+
+    def _decommission(self, entry: ZooEntry) -> None:
+        """Release a dead entry's device residency. The panel is
+        invalidated only when NO resident entry still shares the panel
+        object (a refresh generation over the same panel must not evict
+        the arrays its successor is serving from); invalidation itself
+        is lease-deferred in data/windows.py, so even a racing dispatch
+        is safe."""
+        from lfm_quant_tpu.data.windows import invalidate_panel
+
+        with self._lock:
+            shared = any(e.panel is entry.panel
+                         for e in self._entries.values())
+        if not shared:
+            invalidate_panel(entry.panel)
+        entry._programs.clear()
+        telemetry.COUNTERS.bump("serve_zoo_decommissions")

@@ -53,6 +53,7 @@ fresh wrappers) — the A/B switch the numerical-identity tests use.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -60,18 +61,32 @@ from lfm_quant_tpu.utils import telemetry
 from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
 
 _PROGRAM_CACHE: Dict[Tuple, Any] = {}
+# The serving process fetches programs from several threads (the
+# micro-batcher warming a bucket, a refresh thread rebuilding a
+# trainer); the lock makes hit/miss/evict atomic, and the per-key
+# in-progress map below makes a racing cold key build exactly once
+# WITHOUT serializing unrelated keys behind a multi-second build —
+# cache hits on the serving hot path must never wait out a refresh's
+# trainer construction.
+_PROGRAM_LOCK = threading.RLock()
+_PROGRAM_BUILDING: Dict[Tuple, threading.Event] = {}
 
 # LRU bound on cached program bundles. A walk-forward sweep needs 1–2
 # live keys (trainer + ensemble); the cap covers a handful of coexisting
 # geometries (e.g. an expanding-window sweep drifting across
-# dates_per_batch boundaries, or an A/B of model configs) while keeping
-# the cache from pinning every bundle a long-lived process ever built —
-# each entry holds models, optax chains and jit wrappers whose
-# executable caches hold compiled programs. Evicted bundles keep working
-# for trainers already bound to them (they hold their own references);
-# only the NEXT construction with that key rebuilds.
+# dates_per_batch boundaries, an A/B of model configs, or a serving
+# model zoo's per-bucket scoring programs — the reason the default grew
+# 8 → 32 with the scoring service: U universes × B buckets of serve
+# keys must not evict the trainer bundles a monthly refresh rebinds)
+# while keeping the cache from pinning every bundle a long-lived
+# process ever built — each entry holds models, optax chains and jit
+# wrappers whose executable caches hold compiled programs. Evicted
+# bundles keep working for trainers already bound to them (they hold
+# their own references — the model zoo additionally memoizes its
+# bucket programs per entry for exactly this reason); only the NEXT
+# construction with that key rebuilds.
 _PROGRAM_CACHE_SIZE = max(1, int(os.environ.get("LFM_PROGRAM_CACHE_SIZE",
-                                                "8")))
+                                                "32")))
 
 
 def reuse_enabled() -> bool:
@@ -151,6 +166,24 @@ def foldstack_program_key(inner_key: Tuple, mesh, fold_count: int,
 
     return ("foldstack", inner_key, mesh_fingerprint(mesh), fold_count,
             patience)
+
+
+def serve_program_key(inner_key: Tuple, bucket: Tuple[int, int]) -> Tuple:
+    """Cache key for a serving (bucketed scoring) program: the inner
+    trainer bundle's key (already backend/mesh/gather/window-qualified —
+    the LOOKBACK bucket rides in there as ``cfg.data.window``) plus the
+    padded request-shape bucket ``(rows, cross_section)``. Every field
+    is a TAGGED tuple component, so keys for distinct (inner program,
+    bucket) pairs — and therefore for distinct (universe geometry,
+    bucket, model generation) serving triples — cannot collide by
+    construction: there is no string concatenation or positional
+    ambiguity for adversarial names to exploit, and model GENERATIONS
+    are deliberately ABSENT (generations of one universe share the same
+    compiled programs — that absence is what makes a monthly refresh
+    recompile-free, exactly like the per-fold knobs absent from
+    ``trainer_program_key``)."""
+    rows, width = bucket
+    return ("serve", inner_key, ("bucket", int(rows), int(width)))
 
 
 def multi_step_donate_argnums() -> Tuple[int, ...]:
@@ -242,31 +275,62 @@ def ensemble_program_key(inner_key: Tuple, mesh, n_seeds: int,
 def get_programs(key: Tuple, builder: Callable[[], Any]) -> Any:
     """Fetch the compiled-program bundle for ``key``, building (and
     caching) on miss. With reuse disabled, always builds and never
-    caches — the serial-path A/B baseline."""
-    if reuse_enabled():
-        entry = _PROGRAM_CACHE.pop(key, None)
-        if entry is not None:
-            _PROGRAM_CACHE[key] = entry  # re-insert: LRU recency order
-            REUSE_COUNTERS.program_cache_hits += 1
-            return entry
-    REUSE_COUNTERS.program_cache_misses += 1
-    entry = builder()
-    if reuse_enabled():
-        _PROGRAM_CACHE[key] = entry
-        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
-            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
-    return entry
+    caches — the serial-path A/B baseline. Thread-safe with per-key
+    build serialization: a cold key raced by two threads builds exactly
+    once (the loser waits on the key's event, then hits), while hits
+    and builds of OTHER keys proceed untouched — the builder runs
+    outside the cache lock."""
+    # Counter bumps go through the locked registry bump(): the property
+    # view's `+=` is a two-step read-modify-write that loses increments
+    # under exactly the cross-thread builds this path now allows.
+    if not reuse_enabled():
+        telemetry.COUNTERS.bump("program_cache_misses")
+        return builder()
+    while True:
+        with _PROGRAM_LOCK:
+            entry = _PROGRAM_CACHE.pop(key, None)
+            if entry is not None:
+                _PROGRAM_CACHE[key] = entry  # re-insert: LRU recency order
+                telemetry.COUNTERS.bump("program_cache_hits")
+                return entry
+            evt = _PROGRAM_BUILDING.get(key)
+            if evt is None:
+                _PROGRAM_BUILDING[key] = threading.Event()
+        if evt is not None:
+            evt.wait()
+            continue  # built (hit on re-read) or failed (we build next)
+        telemetry.COUNTERS.bump("program_cache_misses")
+        try:
+            entry = builder()
+        except BaseException:
+            with _PROGRAM_LOCK:
+                _PROGRAM_BUILDING.pop(key).set()  # waiters retry
+            raise
+        with _PROGRAM_LOCK:
+            _PROGRAM_CACHE[key] = entry
+            while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
+                _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+            _PROGRAM_BUILDING.pop(key).set()
+        return entry
 
 
 def clear_program_cache() -> None:
     """Drop all cached program bundles (tests / explicit invalidation).
     Outstanding trainers keep working — they hold their own references —
     but the next construction rebuilds from scratch."""
-    _PROGRAM_CACHE.clear()
+    with _PROGRAM_LOCK:
+        _PROGRAM_CACHE.clear()
 
 
 def program_cache_size() -> int:
     return len(_PROGRAM_CACHE)
+
+
+def program_cache_keys() -> Tuple[Tuple, ...]:
+    """The cached keys in LRU order, oldest first (tests/introspection:
+    the eviction-order and serve-key regression suites read this)."""
+    with _PROGRAM_LOCK:
+        return tuple(_PROGRAM_CACHE)
 
 
 # ---- program ledger -----------------------------------------------------

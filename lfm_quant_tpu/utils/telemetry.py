@@ -80,39 +80,62 @@ def enabled() -> bool:
 
 
 class CounterRegistry:
-    """Process-wide named counters (int or float). Lock-free on purpose:
-    every bump is a single ``dict`` read-modify-write under the GIL, and
-    all hot-path writers (trace counting, H2D accounting, host-sync
-    timing) run on the dispatching thread. Span snapshots from other
-    threads are plain reads — worst case a delta misses an in-flight
-    bump by one, never corrupts."""
+    """Process-wide named counters (int or float), safe under concurrent
+    writers. The pre-serving design was lock-free (every hot-path writer
+    — trace counting, H2D accounting, host-sync timing — ran on the one
+    dispatching thread, so a ``dict`` read-modify-write under the GIL
+    was enough); the scoring service broke that assumption: request
+    threads, the micro-batcher thread and a refresh fit all bump
+    concurrently, and ``c[name] = c.get(name, 0) + value`` loses
+    increments when two threads interleave between the read and the
+    store. Every mutation now takes the registry lock — an uncontended
+    ``threading.Lock`` is tens of nanoseconds against multi-ms
+    dispatches, and the reuse/pipeline lanes' non-interference contract
+    is re-measured with the lock in place. ``get`` stays lock-free (a
+    single dict read is atomic under the GIL; staleness by one in-flight
+    bump was always possible for cross-thread readers and remains the
+    documented worst case)."""
 
-    __slots__ = ("_c",)
+    __slots__ = ("_c", "_lock")
 
     def __init__(self):
         self._c: Dict[str, Any] = {}
+        self._lock = threading.Lock()
 
     def bump(self, name: str, value=1) -> None:
-        c = self._c
-        c[name] = c.get(name, 0) + value
+        with self._lock:
+            c = self._c
+            c[name] = c.get(name, 0) + value
+
+    def peak(self, name: str, value) -> None:
+        """Monotone max: record ``value`` if it exceeds the current one
+        (queue-depth high-water marks and the like)."""
+        with self._lock:
+            c = self._c
+            if value > c.get(name, 0):
+                c[name] = value
 
     def get(self, name: str):
         return self._c.get(name, 0)
 
     def set(self, name: str, value) -> None:
-        self._c[name] = value
+        with self._lock:
+            self._c[name] = value
 
     def snapshot(self) -> Dict[str, Any]:
-        return dict(self._c)
+        with self._lock:
+            return dict(self._c)
 
     def delta(self, since: Dict[str, Any]) -> Dict[str, Any]:
         """Non-zero counter increments since a :meth:`snapshot` (keys
         absent from ``since`` count from 0)."""
-        return {k: v - since.get(k, 0) for k, v in self._c.items()
-                if v != since.get(k, 0)}
+        with self._lock:
+            return {k: v - since.get(k, 0) for k, v in self._c.items()
+                    if v != since.get(k, 0)}
 
     def reset(self) -> None:
-        self._c.clear()
+        with self._lock:
+            self._c.clear()
 
 
 #: The registry every instrument bumps. ``ReuseCounters``

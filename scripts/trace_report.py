@@ -30,10 +30,27 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 from collections import defaultdict
 from typing import Any, Dict, List, Optional
+
+
+def _pctl(values: List[float], q: float) -> Optional[float]:
+    """Linear-interpolation percentile — VERBATIM twin of
+    ``lfm_quant_tpu/serve/stats.py percentile`` (this script must stay
+    importable with no package/jax dependency, so the formula is
+    duplicated; the serve test lane cross-checks the two on the same
+    run dir, and ``bench.py serve`` re-checks at measurement time)."""
+    if not values:
+        return None
+    v = sorted(values)
+    k = (len(v) - 1) * q / 100.0
+    f, c = math.floor(k), math.ceil(k)
+    if f == c:
+        return float(v[int(k)])
+    return float(v[f] * (c - k) + v[c] * (k - f))
 
 
 def _read_jsonl(path: str) -> List[Dict[str, Any]]:
@@ -196,6 +213,42 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
             "early_stops": [{"fold": a.get("fold"), "epoch": a.get("epoch")}
                             for a in stops],
         }
+    # Serving rollup (scoring service, lfm_quant_tpu/serve/): latency
+    # percentiles from the per-request ``latency_ms`` the serve_request
+    # spans carry — the SAME numbers ScoringService.stats() and
+    # ``bench.py serve`` report, so the three agree by construction —
+    # plus batch occupancy and queue depth from the serve_batch spans.
+    reqs = [s for s in spans if s.get("name") == "serve_request"]
+    batches = [s for s in spans if s.get("name") == "serve_batch"]
+    if reqs or batches:
+        lat = [s["args"]["latency_ms"] for s in reqs
+               if "latency_ms" in s.get("args", {})]
+        rows = sum(int(s.get("args", {}).get("rows", 0)) for s in batches)
+        real = sum(int(s.get("args", {}).get("rows_real", 0))
+                   for s in batches)
+        depths = [int(s["args"]["queue_depth"]) for s in batches
+                  if "queue_depth" in s.get("args", {})]
+        report["serve"] = {
+            "requests": len(reqs),
+            "completed": len(lat),
+            "p50_ms": _pctl(lat, 50.0),
+            "p99_ms": _pctl(lat, 99.0),
+            "max_ms": max(lat) if lat else None,
+            "batches": len(batches),
+            "rows": rows,
+            "rows_real": real,
+            "mean_occupancy": round(real / rows, 4) if rows else None,
+            "queue_depth_max": max(depths) if depths else None,
+            "zoo_swaps": sum(1 for s in spans
+                             if s.get("name") == "zoo_swap"),
+            "refreshes": sum(1 for s in spans
+                             if s.get("name") == "serve_refresh"),
+            # Steady-state compile accounting: with warmup inside the
+            # run, non-zero means warmup compiles — the serve bench
+            # snapshots counters AFTER warmup to pin zero.
+            "jit_traces_run": counters.get("jit_traces", 0),
+            "panel_transfers_run": counters.get("panel_transfers", 0),
+        }
     m = run["manifest"]
     if m:
         jx = m.get("jax") if isinstance(m.get("jax"), dict) else {}
@@ -256,6 +309,17 @@ def print_report(rep: Dict[str, Any]) -> None:
               f"epochs/fold={fs.get('epochs_per_fold')}  "
               f"best={fs.get('best_epochs')}  "
               f"early_stops={len(fs.get('early_stops') or [])}")
+    sv = rep.get("serve")
+    if sv:
+        p50 = sv.get("p50_ms")
+        p99 = sv.get("p99_ms")
+        print(f"serve       : {sv['requests']} requests in "
+              f"{sv['batches']} batches  "
+              f"p50 {p50 if p50 is None else f'{p50:.2f}'}ms  "
+              f"p99 {p99 if p99 is None else f'{p99:.2f}'}ms  "
+              f"occupancy {sv.get('mean_occupancy')}  "
+              f"queue<= {sv.get('queue_depth_max')}  "
+              f"swaps {sv.get('zoo_swaps')}")
     print(f"host syncs  : {rep['host_syncs']} "
           f"({rep['syncs_per_epoch']}/epoch, {rep['host_sync_s']:.3f}s "
           f"blocked)" if rep["syncs_per_epoch"] is not None else

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Always-on scoring service entry point (L7 — lfm_quant_tpu/serve/).
+
+Stands up a persistent :class:`ScoringService`: a model zoo resident in
+HBM (one entry per universe, LRU-evicted, atomically swapped on
+refresh), a request micro-batcher coalescing concurrent queries into
+the compiled scoring core through padded request-shape buckets (zero
+jit traces and zero panel H2D in steady state), and per-request latency
+telemetry (``scripts/trace_report.py`` rolls the run dir up).
+
+Demo/smoke mode (default): builds ``--universes`` toy universes with
+distinct cross-section sizes and lookbacks, trains each briefly
+(``--train-epochs``; 0 = fresh init, shape-only), warms every bucket,
+drives ``--requests`` mixed queries from ``--threads`` client threads
+(one ``--refresh`` swap mid-stream if asked) and prints the stats
+rollup. With ``--http PORT`` it additionally exposes the service on a
+stdlib JSON endpoint until interrupted:
+
+    GET /score?universe=u0&month=199001   → scores for the month
+    GET /stats                            → the stats() rollup
+    GET /healthz                          → 200 ok
+
+Usage:
+    python serve.py --universes 3 --requests 200 --run-dir runs/serve
+    python serve.py --train-epochs 2 --http 8777
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def build_universes(n: int, train_epochs: int, echo: bool = False):
+    """N toy universes with DISTINCT geometries (cross-section width
+    and lookback window), each a fitted/initialized Trainer — the
+    mixed-shape traffic the bucket ladder exists for."""
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.data.panel import PanelSplits
+    from lfm_quant_tpu.train.loop import Trainer
+
+    out = {}
+    for k in range(n):
+        n_firms = 60 + 60 * k           # distinct universe sizes
+        window = 6 + 3 * k              # distinct lookbacks
+        cfg = RunConfig(
+            name=f"serve_u{k}",
+            data=DataConfig(n_firms=n_firms, n_months=200, n_features=5,
+                            window=window, dates_per_batch=4,
+                            firms_per_date=32),
+            model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+            optim=OptimConfig(lr=1e-3, epochs=max(1, train_epochs),
+                              warmup_steps=5, loss="mse"),
+            seed=k,
+        )
+        panel = synthetic_panel(n_firms=n_firms, n_months=200,
+                                n_features=5, seed=100 + k)
+        splits = PanelSplits.by_date(panel, 198001, 198201)
+        trainer = Trainer(cfg, splits, run_dir=None, echo=echo)
+        if train_epochs > 0:
+            trainer.fit()
+        else:
+            trainer.state = trainer.init_state()
+        out[f"u{k}"] = (trainer, splits)
+    return out
+
+
+def drive_load(service, n_requests: int, n_threads: int,
+               refresh_mid: bool = False):
+    """Closed-loop mixed-shape load: each client thread round-robins
+    universes and months. Returns (wall_s, errors, refreshed_gen)."""
+    import numpy as np
+
+    universes = service.zoo.universes()
+    months = {u: service.serveable_months(u) for u in universes}
+    done = [0]
+    errors = []
+    lock = threading.Lock()
+
+    def client(cid: int):
+        rng = np.random.default_rng(cid)
+        while True:
+            with lock:
+                if done[0] >= n_requests:
+                    return
+                done[0] += 1
+            u = universes[int(rng.integers(len(universes)))]
+            m = months[u][int(rng.integers(len(months[u])))]
+            try:
+                service.score(u, m)
+            except Exception as e:  # noqa: BLE001 — tallied, not fatal
+                errors.append(f"{u}/{m}: {type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    refreshed = None
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(n_threads)]
+    for t in threads:
+        t.start()
+    if refresh_mid and universes:
+        # One mid-stream refresh of the first universe: same split
+        # boundaries re-posed as "new month arrived" at toy scale (a
+        # real deployment advances them) — the point is the warm
+        # retrain + atomic swap under live traffic.
+        u = universes[0]
+        splits = service.zoo.current(u).trainer.splits
+        refreshed = service.refresh(u, splits, epochs=1).generation
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, errors, refreshed
+
+
+def run_http(service, port: int):
+    """Minimal stdlib JSON front door (demo-grade: one service, GET
+    only; a production deployment would sit behind a real gateway)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from urllib.parse import parse_qs, urlparse
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, payload):
+            body = json.dumps(payload, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            url = urlparse(self.path)
+            try:
+                if url.path == "/healthz":
+                    return self._send(200, {"ok": True})
+                if url.path == "/stats":
+                    return self._send(200, service.stats())
+                if url.path == "/score":
+                    q = parse_qs(url.query)
+                    r = service.score(q["universe"][0],
+                                      int(q["month"][0]))
+                    return self._send(200, {
+                        "universe": r.universe, "month": r.month,
+                        "generation": r.generation,
+                        "latency_ms": r.latency_ms,
+                        "firm_idx": r.firm_idx.tolist(),
+                        "scores": r.scores.tolist()})
+                return self._send(404, {"error": "unknown path"})
+            except KeyError as e:
+                return self._send(404, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — a request must answer
+                return self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    print(f"[serve] http on 127.0.0.1:{port} "
+          f"(/score?universe=u0&month=YYYYMM, /stats, /healthz)",
+          flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--universes", type=int, default=2,
+                    help="toy universes to register (distinct sizes + "
+                         "lookbacks; default 2)")
+    ap.add_argument("--train-epochs", type=int, default=1,
+                    help="epochs to fit each universe before serving "
+                         "(0 = fresh init — shape demo only)")
+    ap.add_argument("--requests", type=int, default=100,
+                    help="demo load: total requests to drive (default 100)")
+    ap.add_argument("--threads", type=int, default=4,
+                    help="demo load: concurrent client threads")
+    ap.add_argument("--refresh", action="store_true",
+                    help="perform one warm refresh + zoo swap mid-stream")
+    ap.add_argument("--run-dir", default=None,
+                    help="attach telemetry (spans/manifest/trace) here; "
+                         "roll up with scripts/trace_report.py")
+    ap.add_argument("--http", type=int, default=0, metavar="PORT",
+                    help="after the demo load, serve a stdlib JSON "
+                         "endpoint on this port until interrupted")
+    ap.add_argument("--echo", action="store_true",
+                    help="echo training metrics while fitting universes")
+    args = ap.parse_args(argv)
+
+    from lfm_quant_tpu.serve import ScoringService
+    from lfm_quant_tpu.utils import telemetry
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    with telemetry.run_scope(args.run_dir, extra={"entry": "serve"}):
+        service = ScoringService()
+        print(f"[serve] building {args.universes} universe(s)…", flush=True)
+        for name, (trainer, _) in build_universes(
+                args.universes, args.train_epochs, echo=args.echo).items():
+            entry = service.register(name, trainer)
+            print(f"[serve] registered {name}: gen {entry.generation}, "
+                  f"{len(entry.serveable_months())} serveable months, "
+                  f"widths {entry.widths()}", flush=True)
+        snap = REUSE_COUNTERS.snapshot()
+        wall, errors, refreshed = drive_load(
+            service, args.requests, args.threads, refresh_mid=args.refresh)
+        d = REUSE_COUNTERS.delta(snap)
+        stats = service.stats()
+        stats.update(
+            wall_s=round(wall, 3),
+            requests_per_sec=round(args.requests / wall, 1) if wall else None,
+            errors=len(errors),
+            refreshed_generation=refreshed,
+            steady_jit_traces=d.get("jit_traces", 0),
+            steady_panel_h2d=d.get("panel_transfers", 0),
+        )
+        print(json.dumps(stats, indent=2, default=str))
+        for e in errors[:5]:
+            print(f"[serve] ERROR {e}", file=sys.stderr)
+        if args.run_dir:
+            print(f"[serve] telemetry in {args.run_dir} — "
+                  f"python scripts/trace_report.py {args.run_dir}")
+        try:
+            if args.http:
+                run_http(service, args.http)
+        finally:
+            service.close()
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Serving request-shape buckets + key/stats pure logic (serve lane).
+
+The device-free half of the scoring-service contract
+(lfm_quant_tpu/serve/): bucket quantization, program/routing key
+collision-freedom, knob parsing, and the latency-percentile formula
+shared (by pinned duplication) with ``scripts/trace_report.py``. The
+integration half — dispatch parity, steady-state counters, refresh
+under traffic — lives in tests/test_serve.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.serve import buckets
+from lfm_quant_tpu.serve.buckets import (
+    bucket_rows,
+    bucket_width,
+    rows_ladder,
+    width_ladder,
+)
+from lfm_quant_tpu.serve.stats import latency_summary, percentile
+from lfm_quant_tpu.train import reuse
+
+pytestmark = pytest.mark.serve
+
+
+def test_bucket_quantization():
+    assert bucket_width(1) == 8 and bucket_width(8) == 8
+    assert bucket_width(9) == 16 and bucket_width(1000) == 1024
+    assert bucket_rows(1, 8) == 1 and bucket_rows(3, 8) == 4
+    assert bucket_rows(100, 8) == 8  # capped at the batcher's max
+    with pytest.raises(ValueError):
+        bucket_width(0)
+    with pytest.raises(ValueError):
+        bucket_rows(0, 8)
+
+
+def test_bucket_ladders_are_finite_and_cover():
+    """Warmup pre-traces rows_ladder × width_ladder; every shape the
+    batcher can produce must be a ladder member — that totality is the
+    zero-compile-steady-state argument."""
+    assert rows_ladder(8) == [1, 2, 4, 8]
+    assert rows_ladder(6) == [1, 2, 4, 8]  # cap rounds up to its bucket
+    assert rows_ladder(1) == [1]
+    assert width_ladder([5, 9, 12, 900]) == [8, 16, 1024]
+    assert width_ladder([]) == []
+    for n in range(1, 64):
+        assert bucket_rows(n, 8) in rows_ladder(8)
+    for n in (1, 7, 8, 9, 100, 513):
+        assert bucket_width(n) in width_ladder([n])
+
+
+def test_serve_program_key_no_collisions():
+    """Keys for distinct (inner program, bucket) pairs are distinct by
+    CONSTRUCTION (tagged tuples — no positional/concatenation ambiguity
+    for adversarial universe names or generation numbers to exploit)."""
+    inner_a = ("trainer", "cpu", ("geometry", 1))
+    inner_b = ("trainer", "cpu", ("geometry", 2))
+    keys = {
+        reuse.serve_program_key(inner_a, (1, 64)),
+        reuse.serve_program_key(inner_a, (16, 4)),   # rows/width swapped
+        reuse.serve_program_key(inner_a, (4, 16)),
+        reuse.serve_program_key(inner_a, (1, 128)),
+        reuse.serve_program_key(inner_b, (1, 64)),
+        reuse.serve_program_key(inner_b, (1, 128)),
+    }
+    assert len(keys) == 6
+    # And none collides with a trainer/ensemble/foldstack-tagged key.
+    assert all(k[0] == "serve" for k in keys)
+
+
+def test_serve_knob_defaults(monkeypatch):
+    for var in ("LFM_SERVE_MAX_ROWS", "LFM_SERVE_MAX_WAIT_MS",
+                "LFM_SERVE_ZOO"):
+        monkeypatch.delenv(var, raising=False)
+    assert buckets.max_rows_default() == 8
+    assert buckets.max_wait_ms_default() == 2.0
+    assert buckets.zoo_capacity_default() == 8
+    monkeypatch.setenv("LFM_SERVE_MAX_ROWS", "16")
+    monkeypatch.setenv("LFM_SERVE_MAX_WAIT_MS", "0.5")
+    monkeypatch.setenv("LFM_SERVE_ZOO", "0")  # floored at 1
+    assert buckets.max_rows_default() == 16
+    assert buckets.max_wait_ms_default() == 0.5
+    assert buckets.zoo_capacity_default() == 1
+
+
+def _load_trace_report():
+    from lfm_quant_tpu.serve.stats import load_trace_report
+
+    return load_trace_report(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_percentile_formula_matches_trace_report_twin():
+    """The duplicated percentile implementations (serve/stats.py and
+    scripts/trace_report.py — the script must stay dependency-free)
+    are pinned equal on adversarial samples, and to numpy."""
+    tr = _load_trace_report()
+    rng = np.random.default_rng(0)
+    for vals in ([1.0], [3.0, 1.0], list(rng.uniform(0, 50, 97)),
+                 [2.0] * 10, list(rng.exponential(5, 256))):
+        for q in (50.0, 90.0, 99.0):
+            a, b = percentile(vals, q), tr._pctl(list(vals), q)
+            assert a == b
+            assert a == pytest.approx(float(np.percentile(vals, q)))
+    assert percentile([], 50.0) is None and tr._pctl([], 50.0) is None
+
+
+def test_latency_summary_fields():
+    s = latency_summary([4.0, 1.0, 2.0, 3.0])
+    assert s["requests"] == 4
+    assert s["p50_ms"] == pytest.approx(2.5)
+    assert s["max_ms"] == 4.0
+    empty = latency_summary([])
+    assert empty["requests"] == 0
+    assert empty["p50_ms"] is None and empty["max_ms"] is None

@@ -355,3 +355,43 @@ def test_persistent_cache_knob_populates_dir_cold(tmp_path):
         assert reuse.enable_persistent_cache(None) == str(cache_dir)
     finally:
         os.environ.pop("LFM_COMPILATION_CACHE", None)
+
+
+def test_program_cache_readmission_builds_exactly_once(monkeypatch):
+    """After LRU eviction, the NEXT fetch of the evicted key rebuilds
+    exactly once and re-enters the LRU; a fetch whose builder returns an
+    ALREADY-BUILT bundle (the serving zoo's re-seed path) re-admits it
+    without constructing anything new."""
+    monkeypatch.setattr(reuse, "_PROGRAM_CACHE_SIZE", 2)
+    builds = []
+
+    def builder(tag):
+        return lambda: builds.append(tag) or f"bundle-{tag}"
+
+    a = reuse.get_programs(("k", "a"), builder("a"))
+    reuse.get_programs(("k", "b"), builder("b"))
+    reuse.get_programs(("k", "c"), builder("c"))  # evicts "a"
+    assert reuse.program_cache_keys() == (("k", "b"), ("k", "c"))
+    # Re-admission of the evicted key: exactly one rebuild...
+    a2 = reuse.get_programs(("k", "a"), builder("a2"))
+    assert builds == ["a", "b", "c", "a2"]
+    assert a2 == "bundle-a2" and a2 != a
+    # ...and a holder of the OLD bundle can re-seed it instead (builder
+    # returns the existing object — admitted, nothing rebuilt).
+    reuse.get_programs(("k", "held"), lambda: a)
+    assert builds == ["a", "b", "c", "a2"]
+    assert reuse.get_programs(("k", "held"), builder("never")) is a
+    assert builds == ["a", "b", "c", "a2"]
+
+
+def test_serve_keys_distinct_from_every_other_program_family():
+    """Serve program keys live in the same cache as trainer/ensemble/
+    foldstack bundles; the leading family tag plus tagged bucket tuples
+    make cross-family collisions impossible by construction."""
+    inner = ("trainer", "cpu", "geom")
+    sk = reuse.serve_program_key(inner, (4, 64))
+    assert sk == ("serve", inner, ("bucket", 4, 64))
+    assert sk != ("ensemble", inner, "cpu", 4, 64)
+    assert sk != ("foldstack", inner, "cpu", 4, 64)
+    # rows/width are positionally tagged — transposed buckets differ.
+    assert sk != reuse.serve_program_key(inner, (64, 4))

@@ -1,0 +1,409 @@
+"""Always-on scoring service (lfm_quant_tpu/serve/): the serve lane.
+
+The serving contract, measured not asserted:
+
+* served scores are BIT-IDENTICAL to the batch scoring path
+  (``run_scoring_pipeline``'s aggregation stage) for the same
+  panel/month — the service is a routing/batching layer over the same
+  compiled forward, never a numerical fork;
+* a mixed-shape request stream (distinct universe sizes AND lookbacks)
+  reaches steady state with ZERO new jit traces and ZERO panel H2D
+  after warmup — the request-shape buckets (serve/buckets.py) folded
+  into the program-cache key make arbitrary queries compile-free;
+* an incremental refresh (warm retrain + atomic zoo swap) serves the
+  new generation with no recompile and no dropped/torn request under
+  concurrent traffic;
+* p50/p99 latency and batch occupancy agree between
+  ``ScoringService.stats()``, ``scripts/trace_report.py`` and the bench
+  formulas (same per-request ``latency_ms`` values end to end).
+
+All tests carry the ``serve`` marker (fast lane: ``pytest -m serve``).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.data.windows import clear_panel_cache
+from lfm_quant_tpu.serve import ScoringService
+from lfm_quant_tpu.serve.buckets import bucket_width
+from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.train.loop import Trainer
+from lfm_quant_tpu.utils import telemetry
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+pytestmark = pytest.mark.serve
+
+
+def _cfg(n_firms=80, window=8, seed=0, epochs=1, name="serve_t"):
+    return RunConfig(
+        name=name,
+        data=DataConfig(n_firms=n_firms, n_months=160, n_features=5,
+                        window=window, dates_per_batch=4,
+                        firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=epochs, warmup_steps=2,
+                          loss="mse"),
+        seed=seed,
+    )
+
+
+def _universe(n_firms=80, window=8, seed=0, panel_seed=3, fit=False,
+              train_months=None):
+    """(trainer, panel, splits) for one toy universe; init-state params
+    unless ``fit`` (serving prices routing, not training quality)."""
+    panel = synthetic_panel(n_firms=n_firms, n_months=160, n_features=5,
+                            seed=panel_seed)
+    train_start = None
+    if train_months is not None:
+        y, m = divmod(197801, 100)
+        mm = (y * 12 + (m - 1)) - train_months
+        train_start = (mm // 12) * 100 + (mm % 12) + 1
+    splits = PanelSplits.by_date(panel, 197801, 198001,
+                                 train_start=train_start)
+    tr = Trainer(_cfg(n_firms=n_firms, window=window, seed=seed), splits)
+    if fit:
+        tr.fit()
+    else:
+        tr.state = tr.init_state()
+    return tr, panel, splits
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    """Deterministic counter arithmetic, same as the reuse lane."""
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    yield
+    reuse.clear_program_cache()
+    clear_panel_cache()
+
+
+@pytest.fixture()
+def service():
+    # max_rows=4 keeps the warmup ladder (rows × widths) small — the
+    # lane prices correctness, not warmup breadth.
+    svc = ScoringService(max_rows=4, max_wait_ms=1.0)
+    yield svc
+    svc.close()
+
+
+# ---- keys ----------------------------------------------------------------
+# (Device-free bucket/key/percentile unit tests live in
+# tests/test_buckets.py — this module is the integration half.)
+
+
+def test_zoo_routing_key_no_collisions():
+    """(universe, generation) zoo keys cannot collide across adversarial
+    name/generation splits ("u1", 2) vs ("u", 12)."""
+    tr, _, _ = _universe()
+    from lfm_quant_tpu.serve.zoo import ZooEntry
+
+    e_a = ZooEntry("u1", 2, tr)
+    e_b = ZooEntry("u", 12, tr)
+    assert e_a.key != e_b.key
+    assert e_a.key == ("zoo", ("universe", "u1"), ("generation", 2))
+
+
+# ---- parity: served == batch scoring path --------------------------------
+
+
+def test_served_scores_bit_identical_to_batch_path(service):
+    """The acceptance pin: for every test-range month, the served
+    cross-section scores equal the batch path's
+    (predict → aggregate_scores_device, the scoring stage of
+    ``run_scoring_pipeline``) BIT FOR BIT — and the backtest report
+    built from serve-backed scores equals the batch report exactly."""
+    from lfm_quant_tpu.backtest.jax_engine import (aggregate_scores_device,
+                                                   run_scoring_pipeline)
+
+    tr, panel, splits = _universe(fit=True)
+    service.register("us", tr)
+    fc, valid = tr.predict("test")
+    scores = np.asarray(aggregate_scores_device(fc[None], valid,
+                                                ["mean"])[0])[0]
+    lo, hi = splits.test_range
+    serve_fc = np.zeros_like(fc)
+    checked = 0
+    for t in range(lo, hi):
+        month = int(panel.dates[t])
+        try:
+            r = service.score("us", month)
+        except KeyError:
+            continue  # month has no serveable cross-section
+        assert r.generation == 0 and r.month == month
+        assert r.firm_idx.size == r.scores.size > 0
+        mask = valid[r.firm_idx, t]
+        np.testing.assert_array_equal(r.scores[mask],
+                                      scores[r.firm_idx[mask], t])
+        serve_fc[r.firm_idx[mask], t] = r.scores[mask]
+        checked += int(mask.sum())
+    assert checked > 100  # the comparison really covered the range
+    # End to end: serve-backed forecasts through the fused backtest
+    # reproduce the batch report exactly (same masked values in, same
+    # compiled core).
+    rep_batch = run_scoring_pipeline(fc, valid, panel)["mean"]
+    rep_serve = run_scoring_pipeline(np.where(valid, serve_fc, 0.0),
+                                     valid, panel)["mean"]
+    assert rep_batch.n_months == rep_serve.n_months
+    np.testing.assert_array_equal(rep_batch.monthly_ic,
+                                  rep_serve.monthly_ic)
+
+
+# ---- steady state: zero compiles, zero H2D -------------------------------
+
+
+def test_mixed_shape_stream_zero_traces_zero_h2d(service):
+    """Three universes with distinct cross-section sizes AND lookbacks,
+    warmed at registration; a concurrent mixed request stream must then
+    pay ZERO new jit traces and ZERO panel H2D — the bucket ladder +
+    residency caches make steady state compile-free and transfer-free."""
+    geos = [(60, 6, 11), (110, 9, 12), (160, 12, 13)]
+    for k, (n_firms, window, pseed) in enumerate(geos):
+        tr, _, _ = _universe(n_firms=n_firms, window=window, seed=k,
+                             panel_seed=pseed)
+        service.register(f"u{k}", tr)
+    months = {u: service.serveable_months(u)
+              for u in service.zoo.universes()}
+    # One sequential pass first: the batcher's coalescing pattern is
+    # load-dependent, but every (rows, width) bucket it can produce was
+    # warmed, so no pattern may trace.
+    snap = REUSE_COUNTERS.snapshot()
+    for u in months:
+        service.score(u, months[u][5])
+    errors = []
+
+    def client(cid):
+        rng = np.random.default_rng(cid)
+        for _ in range(25):
+            u = f"u{int(rng.integers(3))}"
+            m = months[u][int(rng.integers(len(months[u])))]
+            try:
+                r = service.score(u, m)
+                if r.scores.size == 0:
+                    errors.append(f"{u}/{m}: empty")
+            except Exception as e:  # noqa: BLE001 — tallied for assert
+                errors.append(f"{u}/{m}: {e}")
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    d = REUSE_COUNTERS.delta(snap)
+    assert d.get("jit_traces", 0) == 0, d
+    assert d.get("panel_transfers", 0) == 0, d
+    assert service.stats()["completed"] >= 103
+
+
+# ---- incremental refresh -------------------------------------------------
+
+
+def test_refresh_swap_no_recompile_no_dropped_request(service):
+    """Monthly data arrival: a warm single-fold retrain + atomic zoo
+    swap under CONCURRENT traffic — zero new jit traces end to end
+    (same-shape rolling fold = program-cache hit; adopted bucket
+    programs), every request answered (none dropped), every response
+    entirely from one generation (none torn), and the new generation
+    serves afterwards."""
+    tr, panel, _ = _universe(fit=True, train_months=72)
+    service.register("us", tr)
+    months = service.serveable_months("us")
+    for m in months[:4]:
+        service.score("us", m)  # settle the serving path
+    stop = threading.Event()
+    seen = []
+    errors = []
+
+    def hammer():
+        rng = np.random.default_rng(0)
+        while not stop.is_set():
+            m = months[int(rng.integers(len(months)))]
+            try:
+                r = service.score("us", m)
+                seen.append(r.generation)
+            except Exception as e:  # noqa: BLE001 — tallied for assert
+                errors.append(str(e))
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    snap = REUSE_COUNTERS.snapshot()
+    # The advanced rolling fold: same train_months window, boundaries
+    # stepped one year — identical shapes, so everything is warm.
+    splits2 = PanelSplits.by_date(panel, 197901, 198101,
+                                  train_start=197301)
+    entry = service.refresh("us", splits2)
+    stop.set()
+    t.join()
+    d = REUSE_COUNTERS.delta(snap)
+    assert entry.generation == 1
+    assert service.zoo.generation("us") == 1
+    assert d.get("jit_traces", 0) == 0, d
+    assert not errors, errors[:3]
+    assert seen, "hammer thread never completed a request"
+    # No torn request: generations observed are only {0, 1}, and once
+    # the swap lands the stream moves to 1 (monotone non-decreasing).
+    assert set(seen) <= {0, 1}
+    assert sorted(seen) == seen
+    r = service.score("us", months[10])
+    assert r.generation == 1
+    # The refreshed params actually changed the served model (it
+    # trained on a year of newer data).
+    import jax
+
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(entry.params),
+                        jax.tree.leaves(tr.state.params)))
+    assert changed, "refresh published byte-identical params"
+
+
+def test_refresh_copy_protects_served_params_from_donation(service):
+    """The refresh warm start feeds the donating fit a COPY of the
+    served params: after a refresh, the OLD generation's params must
+    still be alive (an in-flight dispatch may still read them) — a
+    refactor that hands the live buffers to the donated TrainState
+    fails here with deleted arrays."""
+    import jax
+
+    tr, panel, _ = _universe(fit=True, train_months=72)
+    service.register("us", tr)
+    old_params = service.zoo.current("us").params
+    splits2 = PanelSplits.by_date(panel, 197901, 198101,
+                                  train_start=197301)
+    service.refresh("us", splits2)
+    assert not any(leaf.is_deleted()
+                   for leaf in jax.tree.leaves(old_params))
+
+
+# ---- zoo LRU / refcount --------------------------------------------------
+
+
+def test_zoo_lru_eviction_is_refcount_safe():
+    """Over-capacity registration evicts the least-recently-leased
+    universe; an entry evicted WHILE LEASED stays fully servable until
+    the lease drains, then decommissions exactly once."""
+    svc = ScoringService(zoo_capacity=2, max_rows=2, max_wait_ms=0.0)
+    try:
+        trainers = [
+            _universe(n_firms=60 + 10 * k, seed=k, panel_seed=20 + k)[0]
+            for k in range(3)]
+        svc.register("a", trainers[0])
+        svc.register("b", trainers[1])
+        months_a = svc.serveable_months("a")
+        with svc.zoo.lease("b") as doomed_entry:
+            # Leasing bumps recency, so refresh 'a' AFTER taking the
+            # lease: 'b' (still leased) becomes the LRU victim.
+            svc.score("a", months_a[5])
+            svc.register("c", trainers[2])  # evicts 'b' while leased
+            assert set(svc.zoo.universes()) == {"a", "c"}
+            # The leased entry still serves: its programs/panel are
+            # pinned (decommission deferred to release).
+            t = int(doomed_entry._sampler.months_with_anchors()[0])
+            pool = doomed_entry.pool(t)
+            assert pool.size > 0
+            with doomed_entry.lease_panel() as dev:
+                out = np.asarray(doomed_entry.programs_for(
+                    (1, bucket_width(pool.size)))(
+                        doomed_entry.params, dev,
+                        np.zeros((1, bucket_width(pool.size)), np.int32),
+                        np.asarray([t], np.int32),
+                        np.zeros((1, bucket_width(pool.size)),
+                                 np.float32)))
+            assert out.shape == (1, bucket_width(pool.size))
+            assert doomed_entry.doomed
+        assert telemetry.COUNTERS.get("serve_zoo_evictions") >= 1
+        with pytest.raises(KeyError):
+            svc.score("b", months_a[5], timeout=5)
+    finally:
+        svc.close()
+
+
+# ---- latency observability: stats == trace_report == bench formulas ------
+
+
+def _load_trace_report():
+    from lfm_quant_tpu.serve.stats import load_trace_report
+
+    return load_trace_report(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_stats_agree_with_trace_report_within_1pct(tmp_path, monkeypatch):
+    """The acceptance pin: p50/p99 and occupancy from a served run's
+    stats() equal scripts/trace_report.py's rollup of the same run dir
+    within 1% (they consume the same per-request latency_ms values, so
+    the agreement is exact up to float repr), and queue-depth counters
+    surface in the serve section."""
+    monkeypatch.setenv("LFM_TELEMETRY", "1")
+    assert telemetry._ACTIVE is None
+    run_dir = str(tmp_path / "serve_run")
+    with telemetry.run_scope(run_dir, extra={"entry": "test_serve"}):
+        svc = ScoringService(max_rows=4, max_wait_ms=1.0)
+        try:
+            tr_a, _, _ = _universe(seed=0, panel_seed=31)
+            tr_b, _, _ = _universe(n_firms=120, window=10, seed=1,
+                                   panel_seed=32)
+            svc.register("a", tr_a)
+            svc.register("b", tr_b)
+            months = {u: svc.serveable_months(u) for u in ("a", "b")}
+
+            def client(cid):
+                rng = np.random.default_rng(cid)
+                for _ in range(20):
+                    u = ("a", "b")[int(rng.integers(2))]
+                    svc.score(u, months[u][int(rng.integers(
+                        len(months[u])))])
+
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            stats = svc.stats()
+        finally:
+            svc.close()
+    tr_mod = _load_trace_report()
+    rep = tr_mod.build_report(tr_mod.load_run(run_dir))
+    sv = rep.get("serve")
+    assert sv is not None, "trace_report produced no serve section"
+    assert sv["requests"] == sv["completed"] == stats["completed"] == 60
+    assert sv["batches"] == stats["batches"]
+    for key in ("p50_ms", "p99_ms"):
+        assert sv[key] == pytest.approx(stats[key], rel=0.01), (
+            key, sv[key], stats[key])
+    assert sv["mean_occupancy"] == pytest.approx(
+        stats["mean_occupancy"], rel=0.01)
+    assert sv["queue_depth_max"] is not None
+    assert stats["queue_peak"] >= 1
+    # And the spans really are per-request with valid JSON lines.
+    with open(os.path.join(run_dir, "spans.jsonl")) as fh:
+        names = [json.loads(line)["name"] for line in fh]
+    assert names.count("serve_request") == 60
+    assert names.count("serve_batch") == sv["batches"]
+
+
+# ---- misc routing --------------------------------------------------------
+
+
+def test_unknown_universe_and_month_fail_fast(service):
+    tr, panel, _ = _universe()
+    service.register("us", tr)
+    with pytest.raises(KeyError):
+        service.score("nope", 199001, timeout=5)
+    with pytest.raises(KeyError):
+        service.score("us", 999912, timeout=5)  # not a panel month
+    # Live months (no realized target) ARE serveable — the production
+    # query: the last horizon months of the panel.
+    months = service.serveable_months("us")
+    live = int(panel.dates[-2])
+    assert live in months
+    r = service.score("us", live)
+    assert r.scores.size > 0

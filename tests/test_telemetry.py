@@ -291,3 +291,77 @@ def test_reuse_counters_view_and_float_fields():
     assert isinstance(d["host_sync_s"], float)
     assert d["host_sync_s"] == pytest.approx(0.25)
     assert telemetry.COUNTERS.get("jit_traces") == snap["jit_traces"] + 1
+
+
+# ---- serving thread-safety (serve satellite audit) -----------------------
+#
+# The scoring service bumps counters and emits spans from request
+# threads, the micro-batcher thread and a refresh fit concurrently; the
+# registry takes a lock per mutation and the span streams serialize
+# under the run lock. These hammers pin "no lost increments" and "no
+# interleaved-corrupt spans.jsonl lines".
+
+
+def test_counter_registry_hammer_loses_no_increments():
+    """8 threads × 5000 bumps on shared int and float counters — the
+    totals must be EXACT (the pre-lock dict read-modify-write loses
+    increments under exactly this load), and peak() must record the
+    true maximum."""
+    import threading
+
+    reg = telemetry.CounterRegistry()
+    n_threads, n_bumps = 8, 5000
+
+    def worker(tid):
+        for i in range(n_bumps):
+            reg.bump("ints")
+            reg.bump("floats", 0.5)
+            reg.peak("peak", tid * n_bumps + i)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.get("ints") == n_threads * n_bumps
+    assert reg.get("floats") == pytest.approx(n_threads * n_bumps * 0.5)
+    assert reg.get("peak") == n_threads * n_bumps - 1
+    # snapshot/delta under concurrent writers never corrupts shape.
+    snap = reg.snapshot()
+    assert set(snap) == {"ints", "floats", "peak"}
+
+
+def test_concurrent_span_emission_no_torn_lines(tmp_path):
+    """6 threads × 40 spans (sync + async, with counter bumps inside)
+    emitted into one live run: every spans.jsonl line must strict-parse
+    and every span must be present — a torn/interleaved write corrupts
+    the line this test would fail on."""
+    import threading
+
+    n_threads, n_spans = 6, 40
+    with telemetry.run_scope(str(tmp_path)):
+        def worker(tid):
+            for i in range(n_spans):
+                if i % 3 == 0:
+                    h = telemetry.begin_async("hammer_async", tid=tid, i=i)
+                    telemetry.COUNTERS.bump("hammer_counter")
+                    h.end(done=True)
+                else:
+                    with telemetry.span("hammer_sync", tid=tid, i=i):
+                        telemetry.COUNTERS.bump("hammer_counter")
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    recs = _spans(str(tmp_path))  # json.loads on every line — strict
+    names = [r["name"] for r in recs]
+    assert names.count("hammer_sync") + names.count("hammer_async") \
+        == n_threads * n_spans
+    assert telemetry.COUNTERS.get("hammer_counter") >= n_threads * n_spans
+    # The trace stream survived the same load as valid JSON.
+    trace = json.load(open(os.path.join(str(tmp_path), "trace.json")))
+    assert isinstance(trace["traceEvents"], list)

@@ -313,3 +313,113 @@ def test_sub_window_gather_equals_slice_of_full(panel):
         np.testing.assert_array_equal(
             np.asarray(xf)[:, :, s * wl:(s + 1) * wl], np.asarray(xs),
             err_msg=f"shard {s} features")
+
+
+# ---- device-panel residency: concurrency + refcount-safe eviction --------
+#
+# The scoring service dispatches from a micro-batcher thread while
+# refresh/eviction runs elsewhere, so the residency cache is
+# lock-guarded and lease-refcounted (serve satellite work). These
+# regressions pin the three properties that make that safe.
+
+
+def test_panel_cache_cold_race_pays_one_transfer(monkeypatch):
+    """Two threads racing a COLD panel key must pay exactly ONE H2D
+    (pre-lock, both missed and both transferred). The transfer is
+    artificially slowed so the race window is real."""
+    import threading
+    import time
+
+    from lfm_quant_tpu.data import windows
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    panel = synthetic_panel(n_firms=40, n_months=120, n_features=3, seed=77)
+    real = windows.device_panel
+
+    def slow_device_panel(*a, **kw):
+        time.sleep(0.1)  # hold the miss window open
+        return real(*a, **kw)
+
+    monkeypatch.setattr(windows, "device_panel", slow_device_panel)
+    snap = REUSE_COUNTERS.snapshot()
+    devs = [None, None]
+
+    def reader(i):
+        devs[i] = windows.cached_device_panel(panel, None)
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    d = REUSE_COUNTERS.delta(snap)
+    assert d["panel_transfers"] == 1, d
+    assert devs[0] is devs[1]  # the SAME resident entry
+    windows.invalidate_panel(panel)
+
+
+def test_invalidate_during_inflight_lease_defers_drop():
+    """The forged-slow-dispatch regression: a reader holds a lease (an
+    in-flight scoring dispatch) while another thread invalidates the
+    panel. The leased arrays must stay live and usable through the
+    whole dispatch; NEW readers must immediately re-transfer fresh
+    bytes; and the doomed entry finalizes exactly once, at the last
+    release (counted by panel_deferred_drops)."""
+    import threading
+
+    from lfm_quant_tpu.data import windows
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+    from lfm_quant_tpu.utils.telemetry import COUNTERS
+
+    panel = synthetic_panel(n_firms=40, n_months=120, n_features=3, seed=78)
+    snap = REUSE_COUNTERS.snapshot()
+    entered = threading.Event()
+    release = threading.Event()
+    result = {}
+
+    def slow_dispatch():
+        with windows.lease_device_panel(panel, None) as dev:
+            entered.set()
+            release.wait(timeout=30)
+            # The forged "dispatch" consumes the leased arrays AFTER the
+            # invalidation landed — a premature free would break here.
+            result["sum"] = float(jnp.asarray(dev["xm"]).sum())
+
+    t = threading.Thread(target=slow_dispatch)
+    t.start()
+    assert entered.wait(timeout=30)
+    drops0 = COUNTERS.get("panel_deferred_drops")
+    assert windows.invalidate_panel(panel) == 1  # the leased entry
+    # New readers re-transfer immediately (no stale aliasing).
+    dev2 = windows.cached_device_panel(panel, None)
+    assert REUSE_COUNTERS.delta(snap)["panel_transfers"] == 2
+    # The in-flight lease has NOT been finalized yet.
+    assert COUNTERS.get("panel_deferred_drops") == drops0
+    release.set()
+    t.join(timeout=30)
+    assert np.isfinite(result["sum"])  # dispatch completed on live arrays
+    assert COUNTERS.get("panel_deferred_drops") == drops0 + 1
+    # The fresh entry is untouched by the deferred drop.
+    assert windows.cached_device_panel(panel, None) is dev2
+    windows.invalidate_panel(panel)
+
+
+def test_lease_without_invalidation_is_plain_hit():
+    """Leases on a healthy entry are free: same arrays as the unleased
+    path, no transfers, no deferred drops."""
+    from lfm_quant_tpu.data import windows
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+    from lfm_quant_tpu.utils.telemetry import COUNTERS
+
+    panel = synthetic_panel(n_firms=40, n_months=120, n_features=3, seed=79)
+    dev = windows.cached_device_panel(panel, None)
+    drops0 = COUNTERS.get("panel_deferred_drops")
+    snap = REUSE_COUNTERS.snapshot()
+    with windows.lease_device_panel(panel, None) as leased:
+        assert leased is dev
+        with windows.lease_device_panel(panel, None) as nested:
+            assert nested is dev  # reentrant leases stack fine
+    d = REUSE_COUNTERS.delta(snap)
+    assert d["panel_transfers"] == 0
+    assert COUNTERS.get("panel_deferred_drops") == drops0
+    windows.invalidate_panel(panel)
