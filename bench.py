@@ -542,12 +542,13 @@ def bench_walkforward_foldstack() -> None:
             "fold-stacking degraded to the sequential path — no "
             "walkforward_foldstack metric to record")
     max_abs_diff = float(np.abs(fc_seq - fc_stk).max())
-    if max_abs_diff > 1e-4:
+    if not (max_abs_diff <= 1e-4):
         # The speedup must come from removing fixed costs, not from
         # computing something else: the foldstack lane pins stacked
         # forecasts to sequential within float32 reduction-order
         # tolerance, and a row that fails that bound must not be banked
-        # as a performance number.
+        # as a performance number. Inverted compare: a NaN diff (a
+        # diverged fit) must fail CLOSED, and `nan > 1e-4` is False.
         raise RuntimeError(
             f"stacked forecasts diverged from sequential "
             f"(max_abs_diff={max_abs_diff:g} > 1e-4) — parity broken, "
@@ -567,6 +568,140 @@ def bench_walkforward_foldstack() -> None:
         extras["rtt_ms"] = rtt
     _emit("walkforward_foldstack", 3600.0 * n_folds / max(t_stk, 1e-9),
           0.0, **extras)
+
+
+def bench_config_sweep() -> None:
+    """config_sweep — the stacked-run engine's hyperparameter-grid
+    metric: configs/hour with the whole LR × weight-decay grid trained
+    as ONE stacked compiled program (train/stacked.py ``StackedRuns``,
+    per-config hyperparameters as vmapped per-run operands) vs warm
+    sequential per-config fits on the SAME grid.
+
+    Both passes run warm (a throwaway pass per mode first pays tracing /
+    XLA compilation through the reuse caches — note the sequential mode
+    compiles once PER CONFIG: lr/weight_decay are baked constants in
+    ``trainer_program_key``, which is exactly the fixed cost the operand
+    threading removes), so the timed ratio prices the per-config fixed
+    costs the stack amortizes: R-1 walks through per-epoch sampling
+    windows, dispatch latency and metric syncs (one per stacked epoch
+    instead of one per config-epoch) plus trainer construction. The
+    stacked per-config best val ICs are parity-checked against the
+    sequential ones first (bit-equal on a pure-vmap stack; ≤1e-4 under
+    a stack mesh, the sharded reduction-order allowance — the test
+    lanes own the strict bit-identity contract) — the speedup must not
+    come from computing something else. Median-of-3 per the BASELINE.md
+    error-bar protocol.
+    Toy MLP geometry on purpose — the metric prices SWEEP STRUCTURE,
+    not model throughput, which also makes the CPU fallback meaningful
+    when the tunnel is wedged.
+    """
+    import shutil
+    import tempfile
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.train.stacked import run_config_sweep
+
+    n_epochs = int(os.environ.get("LFM_BENCH_SWEEP_EPOCHS", "4"))
+    n_lr = int(os.environ.get("LFM_BENCH_SWEEP_LRS", "4"))
+    n_wd = int(os.environ.get("LFM_BENCH_SWEEP_WDS", "2"))
+    n_epochs, n_lr, n_wd = max(1, n_epochs), max(2, n_lr), max(1, n_wd)
+    grid = [{"lr": 1e-3 * (0.5 ** i), "weight_decay": 1e-4 * (0.1 ** j)}
+            for i in range(n_lr) for j in range(n_wd)]
+    cfg = RunConfig(
+        name="config_sweep_bench",
+        data=DataConfig(n_firms=100, n_months=240, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=n_epochs, warmup_steps=5,
+                          early_stop_patience=n_epochs + 1, loss="mse"),
+        seed=0,
+    )
+    panel = synthetic_panel(n_firms=100, n_months=240, n_features=5, seed=5)
+    R = len(grid)
+
+    def one(stacked: bool, out: str):
+        t0 = time.perf_counter()
+        summary = run_config_sweep(cfg, grid, panel=panel, out_dir=out,
+                                   stacked=stacked)
+        return time.perf_counter() - t0, summary
+
+    root = tempfile.mkdtemp(prefix="lfm_config_sweep_bench_")
+    try:
+        # Warmup passes compile both modes' programs (shared reuse
+        # caches; the sequential pass caches all R per-config bundles);
+        # the timed passes then price the loop, not XLA.
+        one(False, os.path.join(root, "wseq"))
+        _, warm_stk = one(True, os.path.join(root, "wstk"))
+        if not (warm_stk.get("stacked") or {}).get("enabled"):
+            # The stacked pass silently degraded to the sequential path
+            # — emitting would bank a seq-vs-seq row indistinguishable
+            # from a real measurement.
+            raise RuntimeError(
+                "config-sweep stacking degraded to the sequential path "
+                "— no config_sweep metric to record")
+        rtt = dispatch_rtt_ms()
+        reps = max(1, int(os.environ.get("LFM_BENCH_OUTER_REPS", "3")))
+        pairs = []
+        for r in range(reps):
+            t_seq, sum_seq = one(False, os.path.join(root, f"seq{r}"))
+            t_stk, sum_stk = one(True, os.path.join(root, f"stk{r}"))
+            ics_seq = [x["best_val_ic"] for x in sum_seq["runs"]]
+            ics_stk = [x["best_val_ic"] for x in sum_stk["runs"]]
+            if ics_seq != ics_stk:
+                # Shards=auto may legitimately differ at last-ulp under
+                # a stack mesh; anything beyond that is a parity break
+                # that must not be banked as a performance number.
+                import numpy as np
+
+                diff = float(np.max(np.abs(
+                    np.asarray(ics_seq) - np.asarray(ics_stk))))
+                # Inverted compare: a NaN diff (diverged grid point)
+                # must fail CLOSED — `nan > 1e-4` is False.
+                if not (diff <= 1e-4):
+                    raise RuntimeError(
+                        f"stacked sweep diverged from sequential "
+                        f"(max_abs_diff={diff:g} > 1e-4) — parity "
+                        "broken, row not recorded")
+            pairs.append((t_seq, t_stk))
+        last_stack = sum_stk["stacked"]
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    def med(vals):
+        # Same middle-averaging protocol as measure_with_spread: a
+        # nearest-element "median" on an even rep count would just be
+        # the luckier rep.
+        vals = sorted(vals)
+        mid = len(vals) // 2
+        return (vals[mid] if len(vals) % 2
+                else 0.5 * (vals[mid - 1] + vals[mid]))
+
+    # Each mode gets its OWN median — pairing them by rep would let one
+    # transient hiccup on the seq side inflate the banked speedup.
+    t_seq = med(p[0] for p in pairs)
+    t_stk = med(p[1] for p in pairs)
+    rates = sorted(3600.0 * R / max(p[1], 1e-9) for p in pairs)
+    med_rate = 3600.0 * R / max(t_stk, 1e-9)
+    extras = {
+        "unit": "configs/hour",
+        "n_configs": R,
+        "n_epochs": n_epochs,
+        "seq_configs_per_hour": round(3600.0 * R / max(t_seq, 1e-9), 1),
+        "speedup": round(t_seq / max(t_stk, 1e-9), 2),
+        "seq_s": round(t_seq, 2),
+        "stack_s": round(t_stk, 2),
+        "stack_mesh": last_stack.get("stack_mesh"),
+        "stack_block": last_stack.get("stack_block"),
+        "n_reps": len(pairs),
+    }
+    if len(rates) >= 2:
+        extras["spread_pct"] = round(
+            100.0 * (rates[-1] - rates[0]) / max(med_rate, 1e-9), 1)
+        extras["rep_values"] = [round(v, 1) for v in rates]
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("config_sweep", med_rate, 0.0, **extras)
 
 
 def _cpu_metric_fallback(flag: str, budget_s: float) -> bool:
@@ -1294,8 +1429,8 @@ def main() -> int:
             if (os.environ.get("LFM_BENCH_FAKE_WEDGE") != "1"
                     and probe.get("kind") == "tunnel_wedged"):
                 for flag in ("--walkforward-reuse", "--walkforward-foldstack",
-                             "--scoring-pipeline", "--epoch-pipeline",
-                             "--serve"):
+                             "--config-sweep", "--scoring-pipeline",
+                             "--epoch-pipeline", "--serve"):
                     _cpu_metric_fallback(
                         flag,
                         deadline_s - (time.monotonic() - t_start) - 30.0)
@@ -1337,6 +1472,14 @@ def main() -> int:
             print(f"bench_walkforward_foldstack failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             _emit_status("bench_error", stage="walkforward_foldstack",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
+        try:
+            bench_config_sweep()
+        except Exception as e:  # noqa: BLE001 — earlier rows must still reach the driver
+            print(f"bench_config_sweep failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            _emit_status("bench_error", stage="config_sweep",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
         try:
@@ -1397,6 +1540,8 @@ if __name__ == "__main__":
     if "--walkforward-foldstack" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_walkforward_foldstack,
                                      "walkforward_foldstack"))
+    if "--config-sweep" in sys.argv[1:]:
+        sys.exit(_single_metric_main(bench_config_sweep, "config_sweep"))
     if "--scoring-pipeline" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_scoring_pipeline,
                                      "scoring_pipeline"))

@@ -6,9 +6,11 @@ from lfm_quant_tpu.parallel.mesh import (
     FOLD_AXIS,
     SEED_AXIS,
     SEQ_AXIS,
+    STACK_AXIS,
     batch_sharding,
     make_fold_mesh,
     make_mesh,
+    make_stack_mesh,
     mesh_fingerprint,
     replicated,
     seed_sharding,
@@ -27,8 +29,10 @@ __all__ = [
     "DATA_AXIS",
     "SEQ_AXIS",
     "FOLD_AXIS",
+    "STACK_AXIS",
     "make_mesh",
     "make_fold_mesh",
+    "make_stack_mesh",
     "mesh_fingerprint",
     "replicated",
     "batch_sharding",

@@ -40,6 +40,12 @@ SEQ_AXIS = "seq"  # matches parallel/ring.py's axis name
 # axis because, like 'seed', folds exchange no traffic (no per-step
 # collective ever crosses it).
 FOLD_AXIS = "fold"
+# Generic stacked-run axis (train/stacked.py): the same leading
+# independent-work axis when the runs are hyperparameter configs or
+# ensemble replicate groups rather than walk-forward folds. A distinct
+# name keeps fold meshes and config-sweep meshes from fingerprinting
+# equal in the program caches (mesh_fingerprint includes axis names).
+STACK_AXIS = "stack"
 
 
 def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
@@ -106,45 +112,50 @@ def make_mesh(n_seed: int = 1, n_data: Optional[int] = None,
     return Mesh(grid.reshape(n_seed, n_data), (SEED_AXIS, DATA_AXIS))
 
 
-def make_fold_mesh(fold_count: int, inner_mesh: Optional[Mesh] = None,
-                   max_fold: Optional[int] = None) -> Optional[Mesh]:
-    """Mesh for the fold-stacked walk-forward: a leading 'fold' axis
+def make_stack_mesh(run_count: int, inner_mesh: Optional[Mesh] = None,
+                    max_shards: Optional[int] = None,
+                    axis_name: str = STACK_AXIS) -> Optional[Mesh]:
+    """Mesh for a stacked-run sweep (train/stacked.py): a leading
+    independent-run axis — walk-forward folds, hyperparameter configs —
     composed OUTSIDE the trainer's existing seed/data axes.
 
-    The fold axis takes the largest divisor of ``fold_count`` that fits
-    the devices left after the inner mesh's axes (folds are independent,
+    The stack axis takes the largest divisor of ``run_count`` that fits
+    the devices left after the inner mesh's axes (runs are independent,
     so any divisor is legal — a non-divisor would leave ragged shards).
     ``inner_mesh`` is the trainer's own mesh: its seed/data axis SIZES
     are preserved so the inner step/eval programs' collectives (psum over
-    'data'/'seed') bind unchanged inside the fold shard_map. Returns
+    'data'/'seed') bind unchanged inside the stack shard_map. Returns
     ``None`` when no sharding applies (single device, no inner axes, and
-    no divisor > 1) — the caller then runs the pure-vmap fold stack.
-    ``max_fold`` caps the fold axis (the ``LFM_FOLDSTACK_SHARDS`` knob;
-    0 forces the fold axis to 1).
+    no divisor > 1) — the caller then runs the pure-vmap stack.
+    ``max_shards`` caps the stack axis (the ``LFM_FOLDSTACK_SHARDS`` /
+    ``LFM_STACK_SHARDS`` knobs; 0 forces the axis to 1). ``axis_name``
+    is 'fold' for the walk-forward adapter and 'stack' for the generic
+    engine — distinct names keep their mesh fingerprints (and therefore
+    program-cache keys) from colliding.
 
     A seq axis is NOT composed: sequence parallelism's ring collectives
     assume the window shards are the innermost ICI neighbors, which a
-    fold axis would interleave — callers degrade to the sequential
-    walk-forward instead (train/foldstack.py).
+    stack axis would interleave — callers degrade to sequential
+    execution instead (train/stacked.py).
     """
     inner_shape = dict(inner_mesh.shape) if inner_mesh is not None else {}
     if inner_shape.get(SEQ_AXIS, 1) > 1:
-        raise ValueError("fold mesh cannot compose with a live seq axis")
+        raise ValueError("stack mesh cannot compose with a live seq axis")
     inner_shape.pop(SEQ_AXIS, None)
     inner_n = 1
     for v in inner_shape.values():
         inner_n *= v
     budget = max(1, len(jax.devices()) // inner_n)
-    if max_fold is not None:
-        budget = min(budget, max(1, max_fold)) if max_fold > 0 else 1
+    if max_shards is not None:
+        budget = min(budget, max(1, max_shards)) if max_shards > 0 else 1
     n_fold = 1
-    for cand in range(min(fold_count, budget), 1, -1):
-        if fold_count % cand == 0:
+    for cand in range(min(run_count, budget), 1, -1):
+        if run_count % cand == 0:
             n_fold = cand
             break
     if n_fold == 1 and not inner_shape:
-        return None  # nothing to shard — pure vmap over the fold axis
-    axes, sizes = [FOLD_AXIS], [n_fold]
+        return None  # nothing to shard — pure vmap over the stack axis
+    axes, sizes = [axis_name], [n_fold]
     for name in (SEED_AXIS, DATA_AXIS):
         if name in inner_shape:
             axes.append(name)
@@ -165,6 +176,15 @@ def make_fold_mesh(fold_count: int, inner_mesh: Optional[Mesh] = None,
         devs = jax.devices()
     grid = np.asarray(devs[:need]).reshape(sizes)
     return Mesh(grid, tuple(axes))
+
+
+def make_fold_mesh(fold_count: int, inner_mesh: Optional[Mesh] = None,
+                   max_fold: Optional[int] = None) -> Optional[Mesh]:
+    """Fold-stacked walk-forward mesh — :func:`make_stack_mesh` with the
+    'fold' axis name (kept so fold meshes fingerprint exactly as they
+    did before the stack generalization)."""
+    return make_stack_mesh(fold_count, inner_mesh, max_fold,
+                           axis_name=FOLD_AXIS)
 
 
 def shard_map_compat(fn, *, mesh: Mesh, in_specs, out_specs,

@@ -137,31 +137,20 @@ class EnsemblePrograms:
         off-mesh; the shard's block under shard_map).
 
         With ``seed_block`` set, the local stack is stepped in blocks via
-        ``lax.scan`` — peak activation memory drops from all-local-seeds ×
-        per-seed to seed_block × per-seed (params/opt stay resident either
-        way), which is what lets a 64-seed c5 train on a single chip when
-        the vmapped backward doesn't fit HBM. Seeds are independent, so
-        blocking is numerically a pure re-batching."""
-        blk = self.seed_block
-        s_local = fi.shape[0]
-        if not blk or blk >= s_local:
-            return self._vstep(state, dev, fi, ti, w)
-        nb = s_local // blk
+        ``lax.scan`` (train/stacked.py ``scan_in_blocks`` — the shared
+        microbatching the stacked-run engine applies one axis up with
+        ``LFM_STACK_BLOCK``) — peak activation memory drops from
+        all-local-seeds × per-seed to seed_block × per-seed (params/opt
+        stay resident either way), which is what lets a 64-seed c5 train
+        on a single chip when the vmapped backward doesn't fit HBM.
+        Seeds are independent, so blocking is numerically a pure
+        re-batching. Construction validates divisibility, so the
+        helper's silent non-divisor fallback is unreachable here."""
+        from lfm_quant_tpu.train.stacked import scan_in_blocks
 
-        def to_blocks(t):
-            return jax.tree.map(
-                lambda x: x.reshape((nb, blk) + x.shape[1:]), t)
-
-        def body(_, xs):
-            st, f, t, ww = xs
-            return None, self._vstep(st, dev, f, t, ww)
-
-        _, (new_state, ms) = jax.lax.scan(
-            body, None, (to_blocks(state), to_blocks(fi), to_blocks(ti),
-                         to_blocks(w)))
-        unblock = lambda t: jax.tree.map(
-            lambda x: x.reshape((s_local,) + x.shape[2:]), t)
-        return unblock(new_state), unblock(ms)
+        return scan_in_blocks(
+            lambda st, f, t, ww: self._vstep(st, dev, f, t, ww),
+            self.seed_block, (state, fi, ti, w))
 
     def _shard_mapped(self, impl, steps_axis: bool):
         """shard_map an ensemble step over (seed × data): the stacked
@@ -571,14 +560,12 @@ class EnsembleTrainer:
 def run_ensemble_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
                             echo: bool = False, resume: bool = False):
     """Config → panel → splits → vmapped ensemble training → summary."""
-    from lfm_quant_tpu.train.loop import resolve_panel
+    from lfm_quant_tpu.train.loop import default_split_dates, resolve_panel
 
     d = cfg.data
     if panel is None:
         panel = resolve_panel(d)
-    dates = panel.dates
-    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
-    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    train_end, val_end = default_split_dates(panel, d)
     splits = PanelSplits.by_date(panel, train_end, val_end,
                                  train_start=d.train_start)
 
@@ -601,16 +588,14 @@ def run_ensemble_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
 def load_ensemble(run_dir: str, panel: Optional[Panel] = None):
     """Rebuild an EnsembleTrainer from a run dir + restore the stacked
     checkpoint (backtest.py ensemble path)."""
-    from lfm_quant_tpu.train.loop import resolve_panel
+    from lfm_quant_tpu.train.loop import default_split_dates, resolve_panel
 
     with open(os.path.join(run_dir, "config.json")) as fh:
         cfg = RunConfig.from_json(fh.read())
     d = cfg.data
     if panel is None:
         panel = resolve_panel(d)
-    dates = panel.dates
-    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
-    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    train_end, val_end = default_split_dates(panel, d)
     splits = PanelSplits.by_date(panel, train_end, val_end,
                                  train_start=d.train_start)
     trainer = EnsembleTrainer(cfg, splits, run_dir=run_dir)

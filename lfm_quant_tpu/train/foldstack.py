@@ -4,20 +4,23 @@ PRs 1–4 made each walk-forward fold cheap — compile-once programs,
 donated buffers, an async epoch pipeline, telemetry — but folds still
 ran strictly one-after-another in ``run_walkforward``, so the sweep paid
 every per-fold fixed cost serially and left the mesh's spare axes idle.
-The serial dependency between folds is weak by our own measurement
-(``walkforward_warm_start``: warm 4.0 vs cold 3.83 epochs-to-stop), and
-PR 1's rolling ``train_months`` window already guarantees identical fold
-shapes — exactly the precondition for stacking folds into one batched
-program, the replicate-independent-work-into-one-dispatch move of
-multi-GPU RNN data parallelization (PAPERS.md: Khomenko et al. 1708.05604;
-You et al. 1901.08256) applied to the retraining campaign itself.
+PR 5 stacked all same-shape folds on a leading, mesh-shardable fold axis
+and trained them as one pipelined jitted program per epoch; PR 7 then
+extracted the axis-agnostic core of that engine into
+``train/stacked.py`` (:class:`~lfm_quant_tpu.train.stacked.StackedRuns`)
+— leading run axis, vmapped multi-step scan, masked per-run early stop,
+device-side best tracking, one host sync per epoch — so the same
+machinery now also drives hyperparameter-config sweeps. This module is
+the walk-forward ADAPTER over that engine: it owns everything
+fold-shaped (the fold schedule → per-fold configs/splits/run dirs,
+per-fold prediction windows, the degrade-to-sequential contract) while
+the engine owns the stacked execution. Its parity lane
+(``pytest -m foldstack``) pins that the adapterization changed nothing:
 
-Execution model (``LFM_FOLDSTACK`` / ``--wf-foldstack``):
-
-* F same-shape folds stack on a NEW leading ``fold`` axis of one
+* F same-shape folds stack on a leading ``fold`` axis of one
   TrainState; every epoch is ONE jitted program: the vmapped multi-step
   train scan, the chained per-fold validation sweep, and the early-stop
-  CONTROL UPDATE — all device-side (DESIGN.md §13).
+  CONTROL UPDATE — all device-side (DESIGN.md §13, §15).
 * The fold axis is mesh-shardable and composes OUTSIDE the existing
   ``seed`` × ``data`` axes (parallel/mesh.py ``make_fold_mesh``): folds
   are independent, so no collective ever crosses 'fold'.
@@ -25,18 +28,15 @@ Execution model (``LFM_FOLDSTACK`` / ``--wf-foldstack``):
   state update is a select back to its input — params, optimizer
   moments, step counter and dropout stream are BIT-FROZEN while live
   folds continue — and per-fold best-epoch/best-params are tracked
-  device-side (``FoldCtrl`` + the stacked ``best_params`` carry), so the
-  control loop needs no host round-trip between epochs.
+  device-side, so the control loop needs no host round-trip.
 * The PR 3 pipeline contract is kept: the epoch loop runs through
   ``pipeline.run_fit_epochs`` (``LFM_ASYNC`` lookahead included), pays
   ONE blocking host sync per stacked epoch, and an overrun epoch
-  dispatched after every fold died is a device-side no-op (the all-dead
-  mask freezes the whole state) that is never recorded.
+  dispatched after every fold died is a device-side no-op.
 * Per-fold PRNG streams are exact: each fold keeps its own sampler seeds
   (``data/windows.py stack_fold_epochs``) and its own init key
   (``Trainer.init_stacked_states``), so fold k samples and initializes
-  exactly as its sequential run would — the parity the ``foldstack``
-  test lane pins per fold against sequential execution.
+  exactly as its sequential run would.
 
 Durability trade (documented, not hidden): the stacked fit writes NO
 per-epoch checkpoint lines — each fold's ``ckpt/best`` is unstacked from
@@ -52,265 +52,52 @@ from __future__ import annotations
 import dataclasses
 import os
 import warnings
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from lfm_quant_tpu.config import RunConfig
 from lfm_quant_tpu.data.panel import Panel, PanelSplits
-from lfm_quant_tpu.data.windows import (
-    DateBatchSampler,
-    cached_device_panel,
-    stack_fold_epochs,
+from lfm_quant_tpu.train.stacked import (
+    RunCtrl,
+    StackedPrograms,
+    StackedRuns,
+    StackUnavailable,
 )
-from lfm_quant_tpu.parallel.mesh import (
-    DATA_AXIS,
-    FOLD_AXIS,
-    SEED_AXIS,
-    make_fold_mesh,
-    shard_map_compat,
-)
-from lfm_quant_tpu.train.loop import TrainState
 from lfm_quant_tpu.utils import telemetry
-from lfm_quant_tpu.utils.logging import MetricsLogger
-from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS, StepTimer
 
 
-class FoldstackUnavailable(RuntimeError):
-    """A precondition for fold-stacking is unmet (no rolling window,
-    ragged fold shapes, sequence parallelism, F < 2). The walk-forward
-    driver catches this and degrades to the sequential path with a
-    warning — a data-dependent shape mismatch must not kill a sweep that
-    the sequential mode handles fine."""
+class FoldstackUnavailable(StackUnavailable):
+    """A FOLD-specific precondition for stacking is unmet (no rolling
+    window, F < 2). The walk-forward driver catches the shared
+    :class:`StackUnavailable` base — this subclass plus the engine's
+    own data-dependent raises — and degrades to the sequential path
+    with a warning: a shape mismatch must not kill a sweep that the
+    sequential mode handles fine."""
 
 
-class FoldCtrl(NamedTuple):
-    """Device-side per-fold early-stopping state — the FitHarness
-    counters, vectorized over folds and kept on device so the control
-    decision needs no host sync and no lookahead lag: a fold that stops
-    at epoch e is frozen in epoch e+1's program because e+1's dispatch
-    consumes e's output control state directly."""
-
-    live: jax.Array        # [F] bool — fold still training
-    best_ic: jax.Array     # [F] f32 — running best val IC (-inf start)
-    best_epoch: jax.Array  # [F] i32 — epoch of best_ic (-1 start)
-    bad_epochs: jax.Array  # [F] i32 — epochs since last improvement
+#: Back-compat aliases: the device-side control state and the stacked
+#: epoch program now live on the generic engine (train/stacked.py).
+FoldCtrl = RunCtrl
+FoldstackPrograms = StackedPrograms
 
 
-class FoldstackPrograms:
-    """The fold-stacked epoch program, cached in the cross-fold program
-    cache (train/reuse.py ``foldstack_program_key``): ONE jitted (and,
-    under a fold mesh, shard_mapped) function runs the vmapped
-    multi-step train scan, the chained per-fold validation sweep, the
-    bit-freeze select for stopped folds, and the device-side control
-    update. Donation is preserved: the whole carry (stacked TrainState +
-    best params + control) is donated, so XLA aliases the fold-stacked
-    params/opt_state in place exactly like the sequential multi-step
-    wrappers do (train/reuse.py ``multi_step_donate_argnums``).
+class StackedWalkforward(StackedRuns):
+    """One fold-stacked walk-forward sweep: the fold adapter over the
+    generic :class:`StackedRuns` engine.
 
-    Holds only the inner program bundle (TrainerPrograms /
-    EnsemblePrograms) and static geometry — no panel, samplers or
-    TrainState — so cache entries stay lightweight (same invariant as
-    the inner bundles)."""
-
-    def __init__(self, inner, mesh, fold_count: int, patience: int,
-                 ensemble: bool):
-        from lfm_quant_tpu.train.reuse import (ledger_jit,
-                                               multi_step_donate_argnums)
-
-        self.inner = inner
-        self.mesh = mesh
-        self.fold_count = fold_count
-        self.patience = patience
-        self.ensemble = ensemble
-        axes = dict(mesh.shape) if mesh is not None else {}
-        # Axis names live inside the fold shard_map: the inner step's
-        # gradient psum needs 'data'; the control aggregation needs
-        # 'seed' when the ensemble's members are seed-sharded.
-        self._data_axis = DATA_AXIS if DATA_AXIS in axes else None
-        self._seed_axis = (SEED_AXIS if ensemble and SEED_AXIS in axes
-                           else None)
-        donate = multi_step_donate_argnums()
-        self._batch_spec = None
-        if mesh is None:
-            self._jit_epoch = ledger_jit("fold_epoch", self._epoch_impl,
-                                         donate_argnums=donate)
-            return
-        state_spec = (P(FOLD_AXIS, SEED_AXIS) if self._seed_axis
-                      else P(FOLD_AXIS))
-        if ensemble:
-            batch_spec = P(FOLD_AXIS, None, self._seed_axis or None,
-                           self._data_axis or None)
-        elif self._data_axis:
-            batch_spec = P(FOLD_AXIS, None, DATA_AXIS)
-        else:
-            batch_spec = P(FOLD_AXIS)
-        fold_spec = P(FOLD_AXIS)
-        # Exposed: the driver stages batches with THIS spec, so H2D
-        # placement and the shard_map in_specs can never drift apart.
-        self._batch_spec = batch_spec
-        carry_spec = (state_spec, state_spec, fold_spec)
-        metric_spec = {"loss": fold_spec, "ic": (P(FOLD_AXIS, SEED_AXIS)
-                                                 if self._seed_axis
-                                                 else fold_spec)}
-        if not ensemble:
-            metric_spec.update(grad_norm=fold_spec, mse=fold_spec)
-        self._jit_epoch = ledger_jit(
-            "fold_epoch",
-            shard_map_compat(
-                self._epoch_impl,
-                mesh=mesh,
-                in_specs=(carry_spec, P(), batch_spec, batch_spec,
-                          batch_spec, fold_spec, fold_spec, fold_spec,
-                          P()),
-                out_specs=(carry_spec, metric_spec),
-                check_vma=False,
-            ),
-            donate_argnums=donate)
-        self._state_spec = state_spec
-
-    # ---- the fused epoch program ------------------------------------
-
-    def _epoch_impl(self, carry, dev: dict, fi, ti, w, vfi, vti, vw,
-                    epoch):
-        """One stacked epoch: train all live folds, evaluate every fold,
-        update the device-side control state. ``epoch`` is a traced i32
-        scalar (no retrace per epoch). Under the fold mesh this body
-        runs per shard on the local fold block; all arrays below carry
-        the LOCAL fold axis."""
-        state, best_params, ctrl = carry
-        inner = self.inner
-        live = ctrl.live
-
-        if self.ensemble:
-            multi = lambda st, f, t, ww: inner._multi_step_impl(
-                st, dev, f, t, ww)
-        else:
-            ax = (self._data_axis,) if self._data_axis else None
-            multi = lambda st, f, t, ww: inner._multi_step_impl(
-                st, dev, f, t, ww, axis=ax)
-        new_state, ms = jax.vmap(multi)(state, fi, ti, w)
-
-        # Bit-freeze stopped folds: a SELECT back to the input state, not
-        # a zero-weight arithmetic step — Adam moment decay, weight decay
-        # and the step counter would all still move under zeroed
-        # gradients, and the parity contract is bit-frozen params.
-        def sel_live(n, o):
-            m = live.reshape(live.shape + (1,) * (n.ndim - 1))
-            return jnp.where(m, n, o)
-
-        state = jax.tree.map(sel_live, new_state, state)
-
-        # Chained per-fold validation sweep on the post-select params (a
-        # frozen fold re-evaluates its frozen params — masked out of the
-        # control update below, so only live folds' ICs matter).
-        counts = vw.sum(axis=-1)  # [F, M] f32
-        if self.ensemble:
-            seed_fwd = jax.vmap(inner.inner._forward_impl,
-                                in_axes=(0, None, None, None, None))
-
-            def fold_eval(p, vf, vt, vww):
-                _, ic, _ = seed_fwd(p, dev, vf, vt, vww)
-                return ic  # [S_local, M]
-
-            ic = jax.vmap(fold_eval)(state.params, vfi, vti, vw)
-            per_seed = ((ic * counts[:, None, :]).sum(-1)
-                        / counts.sum(-1)[:, None])  # [F, S_local]
-            if self._seed_axis:
-                val_ic = (jax.lax.psum(per_seed.sum(axis=1),
-                                       self._seed_axis)
-                          / inner.n_seeds)
-            else:
-                val_ic = per_seed.mean(axis=1)
-            k_steps = fi.shape[1]
-            loss_sum = ms["loss"].sum(axis=(1, 2))
-            if self._seed_axis:
-                loss_sum = jax.lax.psum(loss_sum, self._seed_axis)
-            metrics = {"loss": loss_sum / (k_steps * inner.n_seeds),
-                       "ic": ic}
-        else:
-            def fold_eval(p, vf, vt, vww):
-                _, ic, mse = inner._forward_impl(p, dev, vf, vt, vww)
-                return ic, mse
-
-            ic, mse = jax.vmap(fold_eval)(state.params, vfi, vti, vw)
-            val_ic = (ic * counts).sum(-1) / counts.sum(-1)  # [F] f32
-            metrics = {"loss": ms["loss"].mean(axis=1),
-                       "grad_norm": ms["grad_norm"].mean(axis=1),
-                       "ic": ic, "mse": mse}
-
-        # Device-side FitHarness: same comparisons, vectorized. A fold
-        # improves strictly (val_ic > best_ic, -inf start ⇒ epoch 0
-        # always improves), otherwise its patience counter advances; a
-        # fold whose counter reaches patience leaves the live set for
-        # every later epoch — including a speculative overrun epoch,
-        # which therefore cannot move any state.
-        improved = live & (val_ic > ctrl.best_ic)
-        best_ic = jnp.where(improved, val_ic, ctrl.best_ic)
-        best_epoch = jnp.where(improved, epoch, ctrl.best_epoch)
-        bad = jnp.where(improved, 0,
-                        jnp.where(live, ctrl.bad_epochs + 1,
-                                  ctrl.bad_epochs))
-
-        def sel_best(n, o):
-            m = improved.reshape(improved.shape + (1,) * (n.ndim - 1))
-            return jnp.where(m, n, o)
-
-        best_params = jax.tree.map(sel_best, state.params, best_params)
-        ctrl = FoldCtrl(live & (bad < self.patience), best_ic, best_epoch,
-                        bad)
-        return (state, best_params, ctrl), metrics
-
-
-class _StackHarness:
-    """Duck-typed FitHarness shell for ``pipeline.run_fit_epochs``:
-    epoch accounting only. Early stopping lives DEVICE-SIDE in the
-    stacked control state; the ``finish`` callback sets ``all_dead``
-    from the fetched live mask, and ``end_epoch`` just reports it (no
-    checkpointing — fold checkpoints are unstacked at finalize)."""
-
-    def __init__(self, epochs: int):
-        self.epochs = epochs
-        self.all_dead = False
-        self._epoch = -1
-
-    def next_epoch(self) -> Optional[int]:
-        nxt = self._epoch + 1
-        if nxt >= self.epochs or self.all_dead:
-            return None
-        self._epoch = nxt
-        return nxt
-
-    def end_epoch(self, epoch, step, state_dict, val_ic) -> bool:
-        return self.all_dead
-
-    @property
-    def last_epoch(self) -> int:
-        return self._epoch
-
-
-class StackedWalkforward:
-    """Driver for one fold-stacked walk-forward sweep.
-
-    Construction validates every stacking precondition (raising
-    :class:`FoldstackUnavailable` on data-dependent mismatches), binds
-    ONE trainer (programs + resident panel through the reuse caches),
-    builds per-fold samplers with the exact per-fold PRNG streams, and
-    fetches the stacked epoch program through the program cache.
-    :meth:`run` trains the stack through the PR 3 pipeline driver and
-    unstacks per-fold results (histories, best checkpoints, predictions).
+    Construction maps the fold schedule onto the engine's run axis —
+    per-fold configs (fold-offset seeds), per-fold rolling-window
+    splits, per-fold run dirs — and validates the FOLD preconditions
+    (rolling ``train_months``; >= 2 folds) before the engine validates
+    the generic ones (same-shape schedules, no seq axis).
+    :meth:`run` trains the stack through the engine and adds the
+    fold-specific tail: each fold's out-of-sample prediction from its
+    unstacked state, executed inside the fold's reuse-delta window.
     """
 
     def __init__(self, cfg: RunConfig, panel: Panel,
                  folds: Sequence[Tuple[int, int, Tuple[int, int]]], *,
                  train_months: Optional[int], out_dir: Optional[str] = None,
                  echo: bool = False):
-        from lfm_quant_tpu.train import reuse
-        from lfm_quant_tpu.train.ensemble import EnsembleTrainer
-        from lfm_quant_tpu.train.loop import Trainer
         from lfm_quant_tpu.train.walkforward import (month_add,
                                                      write_fold_run_dir)
 
@@ -323,223 +110,40 @@ class StackedWalkforward:
                 "fold-stacking needs the rolling train_months window "
                 "(same-shape folds); expanding-window folds have "
                 "fold-varying shapes")
-        self.cfg = cfg
-        self.panel = panel
         self.folds = list(folds)
         self.out_dir = out_dir
-        self.fold_count = len(folds)
-        self.ensemble = cfg.n_seeds > 1
-        self.het = cfg.is_heteroscedastic
-        self.window = cfg.data.window
-        d = cfg.data
+        fold_count = len(folds)
+        ensemble = cfg.n_seeds > 1
 
-        self.fold_cfgs = [dataclasses.replace(cfg, seed=cfg.seed + 1000 * k)
-                          for k in range(self.fold_count)]
-        self.splits = [
+        fold_cfgs = [dataclasses.replace(cfg, seed=cfg.seed + 1000 * k)
+                     for k in range(fold_count)]
+        splits = [
             PanelSplits.by_date(panel, te, ve,
                                 train_start=month_add(te, -train_months))
             for te, ve, _ in folds
         ]
-        self.run_dirs = [os.path.join(out_dir, f"fold_{k}") if out_dir
-                         else None for k in range(self.fold_count)]
-        for k, run_dir in enumerate(self.run_dirs):
+        run_dirs = [os.path.join(out_dir, f"fold_{k}") if out_dir
+                    else None for k in range(fold_count)]
+        for k, run_dir in enumerate(run_dirs):
             if run_dir:
-                write_fold_run_dir(self.fold_cfgs[k], run_dir,
-                                   self.folds[k][0], self.folds[k][1],
-                                   month_add(self.folds[k][0],
-                                             -train_months),
-                                   self.ensemble)
+                write_fold_run_dir(fold_cfgs[k], run_dir,
+                                   folds[k][0], folds[k][1],
+                                   month_add(folds[k][0], -train_months),
+                                   ensemble)
+        super().__init__(fold_cfgs, splits, panel, kind="fold",
+                         run_dirs=run_dirs, echo=echo)
 
-        # ONE trainer, bound to fold 0: supplies the compiled inner
-        # programs, the resolved gather/panel geometry, predict(), and
-        # the state-commit machinery — all through the reuse caches.
-        self.trainer = (EnsembleTrainer if self.ensemble else Trainer)(
-            self.fold_cfgs[0], self.splits[0], run_dir=None, echo=echo)
-        n_seq = getattr(self.trainer, "_n_seq", 1)
-        if n_seq > 1:
-            raise FoldstackUnavailable(
-                "fold-stacking does not compose with sequence "
-                "parallelism (the seq axis' ring collectives assume "
-                "innermost ICI placement)")
+    @property
+    def fold_cfgs(self):
+        return self.run_cfgs
 
-        # Per-fold samplers with the fold's own seed and anchor range —
-        # the exact streams the sequential fold would consume.
-        if self.ensemble:
-            self.fold_samplers = [
-                [DateBatchSampler(
-                    panel, d.window, d.dates_per_batch, d.firms_per_date,
-                    seed=fc.seed + s, min_valid_months=d.min_valid_months,
-                    date_range=sp.train_range, engine=d.sampler_engine)
-                 for s in range(cfg.n_seeds)]
-                for fc, sp in zip(self.fold_cfgs, self.splits)
-            ]
-            steps = [min(s.batches_per_epoch() for s in per_fold)
-                     for per_fold in self.fold_samplers]
-        else:
-            self.fold_samplers = [
-                DateBatchSampler(
-                    panel, d.window, d.dates_per_batch, d.firms_per_date,
-                    seed=fc.seed, min_valid_months=d.min_valid_months,
-                    date_range=sp.train_range, engine=d.sampler_engine)
-                for fc, sp in zip(self.fold_cfgs, self.splits)
-            ]
-            steps = [s.batches_per_epoch() for s in self.fold_samplers]
-        if len(set(steps)) != 1:
-            raise FoldstackUnavailable(
-                f"folds disagree on steps-per-epoch {steps} — the "
-                "rolling window crossed a dates_per_batch boundary")
-        self.steps = steps[0]
+    @property
+    def fold_count(self) -> int:
+        return self.run_count
 
-        # Per-fold validation sweeps, stacked. The eval batch width is
-        # panel-wide (windows.py _eval_bf), so only the month COUNT can
-        # differ — and with a fixed val_months it doesn't; a panel whose
-        # eligible-month count still differs degrades to sequential.
-        val_samplers = [
-            DateBatchSampler(panel, d.window, 1, d.firms_per_date,
-                             seed=fc.seed,
-                             min_valid_months=d.min_valid_months,
-                             min_cross_section=1, date_range=sp.val_range)
-            for fc, sp in zip(self.fold_cfgs, self.splits)
-        ]
-        months = [vs.stacked_eval_months() for vs in val_samplers]
-        if len(set(months)) != 1:
-            raise FoldstackUnavailable(
-                f"folds disagree on eligible val months {months} — "
-                "cannot stack the validation sweeps")
-        vbs = [vs.stacked_cross_sections() for vs in val_samplers]
-        self.counts = np.stack([b.weight.sum(axis=1) for b in vbs])
-
-        # Fold mesh: the new 'fold' axis composed outside the trainer's
-        # own seed/data axes (LFM_FOLDSTACK_SHARDS caps/disables it).
-        self.mesh = make_fold_mesh(self.fold_count, self.trainer.mesh,
-                                   reuse.foldstack_shards())
-        inner = self.trainer.programs
-        self.program_key = reuse.foldstack_program_key(
-            self.trainer.program_key, self.mesh, self.fold_count,
-            cfg.optim.early_stop_patience)
-        self.programs = reuse.get_programs(
-            self.program_key,
-            lambda: FoldstackPrograms(inner, self.mesh, self.fold_count,
-                                      cfg.optim.early_stop_patience,
-                                      self.ensemble))
-        # ONE spec source: the programs' shard_map in_specs — H2D staging
-        # placed with anything else would silently reshard per dispatch.
-        self._batch_spec = self.programs._batch_spec
-
-        if self.mesh is not None:
-            t_mesh = self.trainer.mesh
-            if (t_mesh is not None
-                    and {d.id for d in self.mesh.devices.flat}
-                    == {d.id for d in t_mesh.devices.flat}):
-                # Same device SET (e.g. the inner mesh already spans all
-                # devices, so the fold axis degraded to 1): replicated
-                # placement is device-set-invariant, so the trainer's
-                # resident panel serves the fold mesh as-is — no second
-                # full-panel H2D, no duplicate HBM copy for the sweep.
-                self.dev = self.trainer.dev
-            else:
-                gather_impl = (self.trainer.inner._gather_impl
-                               if self.ensemble
-                               else self.trainer._gather_impl)
-                self.dev = cached_device_panel(
-                    panel, self.mesh,
-                    compute_dtype=(jnp.bfloat16 if cfg.model.bf16
-                                   else None),
-                    raw=False, lane_pad=gather_impl == "pallas")
-        else:
-            self.dev = self.trainer.dev  # same placement — zero extra H2D
-
-        self._vargs = tuple(
-            self._put(np.stack([getattr(b, f) for b in vbs]), P(FOLD_AXIS))
-            for f in ("firm_idx", "time_idx", "weight"))
-
-    # ---- placement ---------------------------------------------------
-
-    def _put(self, a, spec):
-        if self.mesh is None:
-            return jnp.asarray(a)
-        return jax.device_put(a, NamedSharding(self.mesh, spec))
-
-    def init_carry(self):
-        """Fresh stacked carry: per-fold independent init draws (exact
-        sequential parity — see ``init_stacked_states``), best-params
-        copies, and the all-live control state — committed to the fold
-        mesh."""
-        state = self.trainer.init_stacked_states(
-            [fc.seed for fc in self.fold_cfgs])
-        best_params = jax.tree.map(jnp.copy, state.params)
-        F = self.fold_count
-        ctrl = FoldCtrl(
-            live=jnp.ones((F,), bool),
-            best_ic=jnp.full((F,), -jnp.inf, jnp.float32),
-            best_epoch=jnp.full((F,), -1, jnp.int32),
-            bad_epochs=jnp.zeros((F,), jnp.int32),
-        )
-        carry = (state, best_params, ctrl)
-        if self.mesh is None:
-            return carry
-        state_spec = getattr(self.programs, "_state_spec", P(FOLD_AXIS))
-
-        def shard_of(spec):
-            return lambda x: NamedSharding(
-                self.mesh,
-                spec if getattr(x, "ndim", 0) >= len(spec) else P(FOLD_AXIS))
-
-        shardings = (jax.tree.map(shard_of(state_spec), state),
-                     jax.tree.map(shard_of(state_spec), best_params),
-                     jax.tree.map(shard_of(P(FOLD_AXIS)), ctrl))
-        return jax.device_put(carry, shardings)
-
-    # ---- epoch callbacks (pipeline.run_fit_epochs contract) ----------
-
-    def build_epoch(self, epoch: int):
-        """Host sampling + H2D staging for one stacked epoch — runs on
-        the prefetch thread under ``LFM_ASYNC`` (pure deterministic reads
-        per (seed, epoch), the same thread-safety contract as the
-        sequential build)."""
-        with telemetry.span("sample", epoch=epoch, folds=self.fold_count):
-            if self.ensemble:
-                stacks = []
-                for per_fold in self.fold_samplers:
-                    per_seed = [s.stacked_epoch(epoch) for s in per_fold]
-                    # Same loud contract as stack_fold_epochs: the
-                    # truncate-to-min-K the sequential ensemble applies
-                    # is only legal down to the init-time steps count —
-                    # a shorter member epoch would silently train this
-                    # fold on a partial epoch.
-                    if min(b.firm_idx.shape[0] for b in per_seed) \
-                            < self.steps:
-                        raise ValueError(
-                            "fold-stacked ensemble epoch shorter than "
-                            f"the {self.steps}-step schedule — member "
-                            "samplers drifted out of shape")
-                    stacks.append(tuple(
-                        np.stack([getattr(b, f)[:self.steps]
-                                  for b in per_seed], axis=1)
-                        for f in ("firm_idx", "time_idx", "weight")))
-                fi, ti, w = (np.stack([s[i] for s in stacks])
-                             for i in range(3))
-            else:
-                b = stack_fold_epochs(self.fold_samplers, epoch)
-                fi, ti, w = b.firm_idx, b.time_idx, b.weight
-            fm = float(w.sum()) * self.window
-        with telemetry.span("h2d", epoch=epoch):
-            spec = self._batch_spec
-            args = tuple(self._put(a, spec) for a in (fi, ti, w))
-        return args + (jnp.asarray(epoch, jnp.int32),), fm
-
-    def dispatch_epoch(self, carry, args):
-        """Queue one stacked epoch (train + eval + control in ONE jitted
-        dispatch). The fetched scalars are COPIES: the next epoch's
-        dispatch donates the carry, and a fetched value must never alias
-        a donated buffer (same rule as the sequential pipeline)."""
-        fi, ti, w, epoch = args
-        carry, vals = self.programs._jit_epoch(
-            carry, self.dev, fi, ti, w, *self._vargs, epoch)
-        state, _, ctrl = carry
-        vals = dict(vals, step=jnp.copy(state.step),
-                    live=jnp.copy(ctrl.live))
-        return carry, vals
+    @property
+    def fold_samplers(self):
+        return self.run_samplers
 
     # ---- the full sweep ---------------------------------------------
 
@@ -549,162 +153,24 @@ class StackedWalkforward:
         ``(fold_summaries, fold_predictions, stack_summary)`` — the
         walk-forward driver stitches the predictions and composes the
         final per-fold records."""
-        from lfm_quant_tpu.train import pipeline
-        from lfm_quant_tpu.train.checkpoint import (CheckpointManager,
-                                                    fold_slice)
-
-        F = self.fold_count
-        snap_stack = REUSE_COUNTERS.snapshot()
-        histories: List[List[Dict[str, Any]]] = [[] for _ in range(F)]
-        loggers = [MetricsLogger(rd) for rd in self.run_dirs]
-        live_mask = np.ones(F, bool)
-        harness = _StackHarness(self.cfg.optim.epochs)
-        timer = StepTimer()
-
-        def finish(epoch, host, fm):
-            nonlocal live_mask
-            live_in = live_mask
-            ic = np.asarray(host["ic"])
-            live_ics = []
-            for f in range(F):
-                if not live_in[f]:
-                    continue
-                if self.ensemble:
-                    per_seed = ((ic[f] * self.counts[f]).sum(axis=1)
-                                / self.counts[f].sum())
-                    val_ic = float(per_seed.mean())
-                    rec = loggers[f].log(
-                        int(np.asarray(host["step"][f]).reshape(-1)[0]),
-                        epoch=epoch,
-                        train_loss=float(host["loss"][f]),
-                        val_ic=val_ic,
-                        val_ic_std=float(per_seed.std()),
-                        firm_months_per_sec=timer.throughput(),
-                    )
-                else:
-                    # f64 np.average — the exact aggregation finish()
-                    # applies on the sequential path, over the same
-                    # per-month ICs, so recorded histories match.
-                    val_ic = float(np.average(ic[f],
-                                              weights=self.counts[f]))
-                    rec = loggers[f].log(
-                        int(host["step"][f]),
-                        epoch=epoch,
-                        train_loss=float(host["loss"][f]),
-                        grad_norm=float(host["grad_norm"][f]),
-                        val_ic=val_ic,
-                        val_mse=float(host["mse"][f]),
-                        firm_months_per_sec=timer.throughput(),
-                    )
-                histories[f].append(rec)
-                live_ics.append(val_ic)
-            new_live = np.asarray(host["live"])
-            for f in range(F):
-                if live_in[f] and not new_live[f]:
-                    telemetry.instant("fold_stopped", fold=f, epoch=epoch)
-            live_mask = new_live
-            harness.all_dead = not bool(new_live.any())
-            step = int(np.max(np.asarray(host["step"])))
-            return step, (float(np.mean(live_ics)) if live_ics else 0.0)
-
-        with telemetry.span("foldstack_fit", cat="fit",
-                            fold_count=F,
-                            fold_mesh=(list(self.mesh.shape.items())
-                                       if self.mesh is not None
-                                       else None)) as sp:
-            carry, overrun = pipeline.run_fit_epochs(
-                harness, self.init_carry(), build=self.build_epoch,
-                dispatch=self.dispatch_epoch, finish=finish, timer=timer,
-                checkpointing=False)
-            state, best_params, ctrl = carry
-            host_ctrl = jax.device_get(ctrl)
-            sp.set(epochs_run=[len(h) for h in histories],
-                   best_epochs=[int(e) for e in host_ctrl.best_epoch],
-                   overrun=overrun is not None)
-        for lg in loggers:
-            lg.close()
-
-        host_best = host_aux = None
-        if self.out_dir:
-            host_best = jax.device_get(best_params)
-            host_aux = jax.device_get({"opt_state": state.opt_state,
-                                       "step": state.step,
-                                       "rng": state.rng})
-        stack_reuse = {
-            k: (round(v, 4) if isinstance(v, float) else v)
-            for k, v in REUSE_COUNTERS.delta(snap_stack).items()}
-
-        fold_summaries: List[Dict[str, Any]] = []
         fold_preds: List[Tuple] = []
-        for f in range(F):
-            snap_fold = REUSE_COUNTERS.snapshot()
-            best_epoch = int(host_ctrl.best_epoch[f])
-            best_val_ic = (histories[f][best_epoch]["val_ic"]
-                           if 0 <= best_epoch < len(histories[f])
-                           else float(host_ctrl.best_ic[f]))
-            best_step = (best_epoch + 1) * self.steps
-            if self.out_dir:
-                # Unstack this fold's ckpt/best line so the run dir is
-                # loadable exactly like a sequential fold's. The params
-                # are the device-tracked best; the aux leaves come from
-                # the final state (predict/backtest only consume
-                # params). The step leaf keeps the FINAL state's SHAPE
-                # — scalar for a Trainer, [S] for the ensemble's
-                # vmapped init — with the best step's value, or Orbax
-                # restore would reject the ensemble's abstract tree.
-                step_leaf = np.full_like(
-                    np.asarray(fold_slice(host_aux["step"], f)), best_step)
-                mgr = CheckpointManager(
-                    os.path.join(self.run_dirs[f], "ckpt", "best"),
-                    max_to_keep=1)
-                mgr.save(best_step, {
-                    "params": fold_slice(host_best, f),
-                    "opt_state": fold_slice(host_aux["opt_state"], f),
-                    "step": step_leaf,
-                    "rng": host_aux["rng"][f],
-                }, wait=True)
-                mgr.close()
-            # Prediction-state parity with the sequential path: a fold
-            # WITH a run dir predicts from its restored ckpt/best (the
-            # device-tracked best params here); without one, sequential
-            # `fit` has no best line to restore and ends on the last
-            # RECORDED epoch's state — mirror that, or LFM_FOLDSTACK
-            # would silently flip forecasts for out_dir=None callers.
-            src = best_params if self.out_dir else state.params
-            fold_state = TrainState(
-                params=jax.tree.map(lambda x: x[f], src),
-                opt_state=jax.tree.map(lambda x: x[f], state.opt_state),
-                step=state.step[f],
-                rng=state.rng[f],
-            )
-            self.trainer.state = self.trainer._commit_state(fold_state)
-            pred_range = self.folds[f][2]
-            with telemetry.span("predict", cat="predict", fold=f):
+
+        def per_fold(k: int) -> None:
+            # Prediction-state parity with the sequential path: see
+            # StackedRuns.run_state — best-tracked params for
+            # checkpointing folds, last recorded state otherwise.
+            self.trainer.state = self.trainer._commit_state(
+                self.run_state(k))
+            pred_range = self.folds[k][2]
+            with telemetry.span("predict", cat="predict", fold=k):
                 if self.het:
                     pred = self.trainer.predict(date_range=pred_range,
                                                 return_variance=True)
                 else:
                     pred = self.trainer.predict(date_range=pred_range)
             fold_preds.append(pred)
-            fold_summaries.append({
-                "best_val_ic": best_val_ic,
-                "best_epoch": best_epoch,
-                "epochs_run": len(histories[f]),
-                "history": histories[f],
-                "reuse": {k: (round(v, 4) if isinstance(v, float) else v)
-                          for k, v in
-                          REUSE_COUNTERS.delta(snap_fold).items()},
-            })
 
-        stack_summary = {
-            "enabled": True,
-            "fold_count": F,
-            "fold_mesh": (list(self.mesh.shape.items())
-                          if self.mesh is not None else None),
-            "steps_per_epoch": self.steps,
-            "lookahead_overrun": overrun is not None,
-            "reuse": stack_reuse,
-        }
+        fold_summaries, stack_summary = self.fit(per_run=per_fold)
         return fold_summaries, fold_preds, stack_summary
 
 
@@ -716,13 +182,18 @@ def run_stacked_walkforward(cfg: RunConfig, panel: Panel, folds, *,
     ``(fold_summaries, fold_predictions, stack_summary)``, or ``None``
     after a warning when a stacking precondition is data-dependently
     unmet (the caller then runs the sequential path — degrade, don't
-    kill a sweep the sequential mode handles)."""
+    kill a sweep the sequential mode handles). The degrade is never
+    silent beyond the warning: it also lands a ``stack_degraded``
+    telemetry instant and bumps the ``stack_degrades`` counter, so
+    ``scripts/trace_report.py`` surfaces it from the run dir alone."""
     try:
         sw = StackedWalkforward(cfg, panel, folds,
                                 train_months=train_months,
                                 out_dir=out_dir, echo=echo)
-    except FoldstackUnavailable as e:
+    except StackUnavailable as e:
         warnings.warn(f"fold-stacking unavailable ({e}); running the "
                       "sequential walk-forward", stacklevel=3)
+        telemetry.instant("stack_degraded", kind="fold", reason=str(e))
+        telemetry.COUNTERS.bump("stack_degrades")
         return None
     return sw.run()

@@ -552,13 +552,15 @@ class TrainerPrograms:
             xm, firm_idx, time_idx, window, fp=self._fp,
             firm_chunk=chunk)
 
-    def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
-                   weight,
-                   axis: Optional[Union[str, Tuple[str, ...]]] = None):
-        """One train step. ``axis`` names the mesh axis this step runs
-        under inside shard_map (None = un-partitioned): the loss is a
-        ratio of data-sums, so the global value needs one psum per part,
-        and gradients psum across shards (replicated params)."""
+    def _grads_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
+                    weight,
+                    axis: Optional[Union[str, Tuple[str, ...]]] = None):
+        """Loss + psum'd gradients of one batch — the optimizer-free
+        half of :meth:`_step_impl`, shared with the stacked engine's
+        per-run-operand hyper step (train/stacked.py): a config sweep
+        computes gradients through exactly this code and applies them
+        with per-run (lr, weight-decay) OPERANDS instead of the baked
+        ``self.tx`` chain, so the two paths cannot drift."""
         step_rng = None
         if self._needs_rng:
             # Derived, never stored: resume replays the same stream; the
@@ -598,6 +600,17 @@ class TrainerPrograms:
         loss, grads = jax.value_and_grad(loss_of)(state.params)
         if axis is not None:
             grads = jax.lax.psum(grads, axis)
+        return loss, grads
+
+    def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
+                   weight,
+                   axis: Optional[Union[str, Tuple[str, ...]]] = None):
+        """One train step. ``axis`` names the mesh axis this step runs
+        under inside shard_map (None = un-partitioned): the loss is a
+        ratio of data-sums, so the global value needs one psum per part,
+        and gradients psum across shards (replicated params)."""
+        loss, grads = self._grads_impl(state, dev, firm_idx, time_idx,
+                                       weight, axis=axis)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
@@ -1369,6 +1382,18 @@ def resolve_panel(d) -> Panel:
     return panel
 
 
+def default_split_dates(panel: Panel, d) -> Tuple[int, int]:
+    """The default (train_end, val_end) boundaries for a DataConfig:
+    the configured dates when set, else the 70%/85% panel quantiles —
+    THE single copy of the policy every entry point (single fit,
+    ensemble, loaders, config sweep) derives its splits from, so none
+    can silently diverge from the fit it is compared against."""
+    dates = panel.dates
+    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
+    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    return train_end, val_end
+
+
 def run_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
                    echo: bool = False, resume: bool = False
                    ) -> Tuple[Dict[str, Any], "Trainer", PanelSplits]:
@@ -1377,9 +1402,7 @@ def run_experiment(cfg: RunConfig, panel: Optional[Panel] = None,
     d = cfg.data
     if panel is None:
         panel = resolve_panel(d)
-    dates = panel.dates
-    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
-    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    train_end, val_end = default_split_dates(panel, d)
     splits = PanelSplits.by_date(panel, train_end, val_end,
                                  train_start=d.train_start)
 
@@ -1405,9 +1428,7 @@ def load_trainer(run_dir: str, panel: Optional[Panel] = None):
     d = cfg.data
     if panel is None:
         panel = resolve_panel(d)
-    dates = panel.dates
-    train_end = d.train_end or int(dates[int(len(dates) * 0.7)])
-    val_end = d.val_end or int(dates[int(len(dates) * 0.85)])
+    train_end, val_end = default_split_dates(panel, d)
     splits = PanelSplits.by_date(panel, train_end, val_end,
                                  train_start=d.train_start)
     trainer = Trainer(cfg, splits, run_dir=run_dir)

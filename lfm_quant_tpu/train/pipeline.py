@@ -166,16 +166,16 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
 
     ``harness`` is duck-typed: the driver consumes only ``epochs``,
     ``next_epoch()`` and ``end_epoch(epoch, step, state_dict, val_ic) ->
-    stop`` — ``FitHarness`` for the sequential trainers, the fold-stack
-    driver's thin shell (train/foldstack.py ``_StackHarness``) when
+    stop`` — ``FitHarness`` for the sequential trainers, the stacked-run
+    engine's thin shell (train/stacked.py ``_StackHarness``) when
     early stopping lives device-side and the stop flag is derived by
-    ``finish`` from the fetched per-fold live mask. ``state`` is equally
+    ``finish`` from the fetched per-run live mask. ``state`` is equally
     opaque: any pytree consumed linearly by ``dispatch`` works (the
-    fold-stacked path threads a (TrainState, best_params, ctrl) carry);
+    stacked path threads a (TrainState, best_params, ctrl) carry);
     async-mode snapshots/rollbacks ``jax.tree.map`` over it wholesale.
 
     Callback contract (shared by Trainer, EnsembleTrainer and the
-    fold-stack driver):
+    stacked-run engine):
 
     * ``build(epoch) -> (batches, firm_months)`` — host sampling + H2D
       staging; MUST be thread-safe for explicit epochs (runs on the

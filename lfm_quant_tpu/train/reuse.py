@@ -153,19 +153,75 @@ def foldstack_shards() -> Optional[int]:
     return max(0, int(v))
 
 
+def stack_shards() -> Optional[int]:
+    """``LFM_STACK_SHARDS``: cap on the generic stacked-run mesh axis
+    (train/stacked.py config sweeps — the fold adapter keeps its own
+    ``LFM_FOLDSTACK_SHARDS``). Unset/"auto" = largest divisor of the run
+    count that fits the devices left by the trainer's own seed/data
+    axes; ``0`` pins the stack axis to 1 (pure-vmap stacking — the
+    sharding A/B switch the bit-identity tests use); ``N`` caps it."""
+    v = os.environ.get("LFM_STACK_SHARDS")
+    if v in (None, "", "auto"):
+        return None
+    return max(0, int(v))
+
+
+def stack_block() -> int:
+    """``LFM_STACK_BLOCK``: microbatch size for the stacked run axis —
+    the run-axis generalization of ``RunConfig.seed_block``. ``B > 0``
+    steps an R-run stack in blocks of B runs via ``lax.scan`` inside the
+    stacked epoch program, bounding peak activation memory to B × per-run
+    instead of all local runs at once (params/opt state stay resident
+    either way) — the same HBM-fit lever that lets a 64-seed ensemble
+    train on one chip (``seed_block=16`` is the flagship's pre-registered
+    plan). 0/unset = all local runs in one vmapped step. Runs are
+    independent, so blocking is numerically a pure re-batching; a block
+    that does not divide the per-shard run count degrades to unblocked
+    with a warning (train/stacked.py). Part of the stacked program keys —
+    a changed block is a different traced program, never stale reuse."""
+    v = os.environ.get("LFM_STACK_BLOCK")
+    if v in (None, ""):
+        return 0
+    return max(0, int(v))
+
+
 def foldstack_program_key(inner_key: Tuple, mesh, fold_count: int,
-                          patience: int) -> Tuple:
+                          patience: int, block: int = 0) -> Tuple:
     """Cache key for the fold-stacked epoch program: the inner trainer/
     ensemble bundle's key (already backend/mesh/donation-qualified) plus
     the fold-stack geometry — fold count and fold-mesh placement change
-    the traced program's shapes/collectives, and the early-stop
-    ``patience`` is baked into the device-side control update as a
-    constant (the sequential path keeps it host-side, so only this key
-    needs it)."""
+    the traced program's shapes/collectives, the early-stop ``patience``
+    is baked into the device-side control update as a constant (the
+    sequential path keeps it host-side, so only this key needs it), and
+    the run-axis microbatch ``block`` (``LFM_STACK_BLOCK``) changes the
+    traced vmap-vs-scan structure."""
     from lfm_quant_tpu.parallel.mesh import mesh_fingerprint
 
     return ("foldstack", inner_key, mesh_fingerprint(mesh), fold_count,
-            patience)
+            patience, block)
+
+
+def stacked_program_key(inner_key: Tuple, mesh, run_count: int,
+                        patience: int, kind: str,
+                        hyper_keys: Tuple[str, ...],
+                        block: int = 0) -> Tuple:
+    """Cache key for a generic stacked-run epoch program
+    (train/stacked.py ``StackedRuns``): the inner trainer bundle's key
+    plus the stack geometry. Every field is a TAGGED tuple component —
+    same construction as :func:`serve_program_key` — so keys from the
+    three stacked families ("foldstack", "stacked", "serve") cannot
+    collide by construction, whatever their inner components. ``kind``
+    labels the run axis ("config", "seed", ...); ``hyper_keys`` names
+    the per-run hyperparameters threaded as vmapped OPERANDS into the
+    epoch program — their VALUES are deliberately absent (they arrive as
+    [R]-shaped arguments, which is exactly what makes a 200-config grid
+    one compiled program), only the set of operand names shapes the
+    trace. ``block`` is the ``LFM_STACK_BLOCK`` run-axis microbatch."""
+    from lfm_quant_tpu.parallel.mesh import mesh_fingerprint
+
+    return ("stacked", inner_key, mesh_fingerprint(mesh), int(run_count),
+            int(patience), ("kind", str(kind)),
+            ("hyper", tuple(hyper_keys)), ("block", int(block)))
 
 
 def serve_program_key(inner_key: Tuple, bucket: Tuple[int, int]) -> Tuple:

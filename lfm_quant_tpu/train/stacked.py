@@ -1,0 +1,1076 @@
+"""Universal stacked-run engine: compile once, train R independent runs.
+
+PR 5's fold-vectorized walk-forward (train/foldstack.py) proved the
+move: stack same-shape independent runs on a leading axis of ONE
+TrainState, drive every epoch as one jitted program (vmapped multi-step
+train scan + chained per-run validation sweep + masked device-side
+early stopping), pay ONE host sync per stacked epoch, and shard the run
+axis over the mesh's spare devices. Nothing in that core is
+fold-specific — it is the replicate-independent-work batching of
+Khomenko et al. (1708.05604) one level up — so this module hoists it
+into a generic :class:`StackedRuns` engine whose leading axis can be:
+
+* **walk-forward folds** — train/foldstack.py is now a thin adapter
+  over this engine (its parity lane pins that the adapterization
+  changed nothing);
+* **hyperparameter configs** — an LR × weight-decay grid trained as ONE
+  compiled program (:func:`run_config_sweep`, ``train.py --sweep-grid``):
+  per-run hyperparameters are threaded as vmapped per-run *operands*
+  into the optimizer update — never baked constants — so a 200-config
+  grid pays zero per-config traces (the training-side twin of the PR 2
+  compile-once mode × λ × cost scoring grid);
+* **compositions** — axes compose by cartesian-flattening the run list
+  (fold × config: each run carries its own splits AND its own config;
+  seeds compose through the ensemble's existing inner 'seed' mesh axis).
+
+Per-run-operand hyperparameters and bit-identity: the sequential
+reference for config c is a Trainer whose optax chain bakes c's LR and
+weight decay in as constants. The stacked hyper step reproduces those
+updates bit-exactly by reusing the SAME gradient code
+(``TrainerPrograms._grads_impl``) and mirroring the optax chain
+(clip → scale_by_adam → +wd·p → −lr·unit_schedule(count)·u) with the
+peak LR factored out of the schedule: optax's warmup-cosine value is
+linear in the peak (init 0, end 0.1·peak), so ``lr ⊗ unit(count)``
+reproduces the baked ``schedule(count)`` to the bit — the ``stacked``
+test lane pins per-config histories, best epochs and restored best
+params bit-identical to sequential execution on the unsharded stack.
+
+Run-axis microbatching (``LFM_STACK_BLOCK``): the generalization of
+``RunConfig.seed_block`` one axis up — an R-run stack whose vmapped
+backward would overflow HBM is stepped in blocks of B runs via
+``lax.scan`` (:func:`scan_in_blocks`, shared with the ensemble's
+seed-block path), bounding peak activation memory to B × per-run while
+params/opt state stay resident. Runs are independent, so blocking is a
+pure re-batching; the block size is part of the stacked program keys.
+
+The mesh axis is 'stack' (parallel/mesh.py ``make_stack_mesh``) — or
+'fold' for the walk-forward adapter, so fold meshes fingerprint exactly
+as before — composed OUTERMOST around the trainer's seed × data axes:
+runs exchange no traffic, so no collective ever crosses the axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from lfm_quant_tpu.config import RunConfig
+from lfm_quant_tpu.data.panel import Panel, PanelSplits
+from lfm_quant_tpu.data.windows import (
+    DateBatchSampler,
+    cached_device_panel,
+    stack_fold_epochs,
+)
+from lfm_quant_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FOLD_AXIS,
+    SEED_AXIS,
+    STACK_AXIS,
+    make_stack_mesh,
+    shard_map_compat,
+)
+from lfm_quant_tpu.train.loop import TrainState
+from lfm_quant_tpu.utils import telemetry
+from lfm_quant_tpu.utils.logging import MetricsLogger
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS, StepTimer
+
+#: Hyperparameters a config grid may vary — each is threaded into the
+#: stacked epoch program as a vmapped [R] operand (never a baked
+#: constant). Anything else that differs across run configs changes the
+#: traced program or its data and must stay uniform within one stack.
+HYPER_KEYS = ("lr", "weight_decay")
+
+
+class StackUnavailable(RuntimeError):
+    """A precondition for run-stacking is unmet (ragged run shapes,
+    R < 2, a config field varying that cannot ride a per-run operand,
+    sequence parallelism). Drivers catch this and degrade to sequential
+    execution with a warning + telemetry instant — a data-dependent
+    mismatch must not kill a sweep the sequential path handles fine."""
+
+
+class RunCtrl(NamedTuple):
+    """Device-side per-run early-stopping state — the FitHarness
+    counters, vectorized over the run axis and kept on device so the
+    control decision needs no host sync and no lookahead lag: a run that
+    stops at epoch e is frozen in epoch e+1's program because e+1's
+    dispatch consumes e's output control state directly."""
+
+    live: jax.Array        # [R] bool — run still training
+    best_ic: jax.Array     # [R] f32 — running best val IC (-inf start)
+    best_epoch: jax.Array  # [R] i32 — epoch of best_ic (-1 start)
+    bad_epochs: jax.Array  # [R] i32 — epochs since last improvement
+
+
+def scan_in_blocks(vfn, block: int, args: Tuple):
+    """Apply a run-axis-vmapped ``vfn`` to ``args`` in blocks of
+    ``block`` runs via ``lax.scan`` — the run-axis generalization of the
+    ensemble's ``seed_block`` microbatching (train/ensemble.py
+    ``_step_shards`` routes through here too): peak activation memory
+    drops from all-local-runs × per-run to block × per-run, while the
+    per-run math is untouched (runs are independent, so blocking is a
+    pure re-batching). ``block`` of 0, >= the local run count, or not
+    dividing it falls through to the plain vmapped call — callers that
+    want a loud non-divisor warn at construction time."""
+    lead = jax.tree.leaves(args)[0].shape[0]
+    if not block or block >= lead or lead % block:
+        return vfn(*args)
+    nb = lead // block
+
+    def to_blocks(t):
+        return jax.tree.map(
+            lambda x: x.reshape((nb, block) + x.shape[1:]), t)
+
+    def body(_, xs):
+        return None, vfn(*xs)
+
+    _, out = jax.lax.scan(body, None, tuple(to_blocks(a) for a in args))
+    return jax.tree.map(lambda x: x.reshape((lead,) + x.shape[2:]), out)
+
+
+class StackedPrograms:
+    """The stacked epoch program, cached in the cross-fold program cache
+    (train/reuse.py ``foldstack_program_key`` / ``stacked_program_key``):
+    ONE jitted (and, under a stack mesh, shard_mapped) function runs the
+    vmapped multi-step train scan, the chained per-run validation sweep,
+    the bit-freeze select for stopped runs, and the device-side control
+    update. Donation is preserved: the whole carry (stacked TrainState +
+    best params + control) is donated, so XLA aliases the run-stacked
+    params/opt_state in place exactly like the sequential multi-step
+    wrappers do (train/reuse.py ``multi_step_donate_argnums``).
+
+    ``hyper_keys`` names the per-run hyperparameters arriving as [R]
+    operands; with any set, the train scan runs the mirrored-optax hyper
+    step instead of the inner bundle's baked multi-step. ``block`` is
+    the ``LFM_STACK_BLOCK`` run-axis microbatch.
+
+    Holds only the inner program bundle (TrainerPrograms /
+    EnsemblePrograms) and static geometry — no panel, samplers or
+    TrainState — so cache entries stay lightweight (same invariant as
+    the inner bundles)."""
+
+    def __init__(self, inner, mesh, run_count: int, patience: int,
+                 ensemble: bool, axis_name: str = FOLD_AXIS,
+                 hyper_keys: Tuple[str, ...] = (), block: int = 0,
+                 steps_per_epoch: int = 0, optim_cfg=None):
+        from lfm_quant_tpu.train.reuse import (ledger_jit,
+                                               multi_step_donate_argnums)
+
+        self.inner = inner
+        self.mesh = mesh
+        self.run_count = run_count
+        self.patience = patience
+        self.ensemble = ensemble
+        self.axis_name = axis_name
+        self.hyper_keys = tuple(hyper_keys)
+        self.hyper = bool(self.hyper_keys)
+        self.block = int(block)
+        axes = dict(mesh.shape) if mesh is not None else {}
+        # Axis names live inside the stack shard_map: the inner step's
+        # gradient psum needs 'data'; the control aggregation needs
+        # 'seed' when the ensemble's members are seed-sharded.
+        self._data_axis = DATA_AXIS if DATA_AXIS in axes else None
+        self._seed_axis = (SEED_AXIS if ensemble and SEED_AXIS in axes
+                           else None)
+        if self.hyper:
+            if ensemble:
+                raise ValueError(
+                    "per-run hyperparameter operands are single-seed "
+                    "only (the ensemble's seed axis composes through "
+                    "its own mesh axis)")
+            self._build_hyper_tx(optim_cfg, steps_per_epoch)
+        donate = multi_step_donate_argnums()
+        self._batch_spec = None
+        hp_spec = {k: P(axis_name) for k in self.hyper_keys}
+        if mesh is None:
+            self._jit_epoch = ledger_jit("stack_epoch", self._epoch_impl,
+                                         donate_argnums=donate)
+            return
+        state_spec = (P(axis_name, SEED_AXIS) if self._seed_axis
+                      else P(axis_name))
+        if ensemble:
+            batch_spec = P(axis_name, None, self._seed_axis or None,
+                           self._data_axis or None)
+        elif self._data_axis:
+            batch_spec = P(axis_name, None, DATA_AXIS)
+        else:
+            batch_spec = P(axis_name)
+        run_spec = P(axis_name)
+        # Exposed: the driver stages batches with THIS spec, so H2D
+        # placement and the shard_map in_specs can never drift apart.
+        self._batch_spec = batch_spec
+        carry_spec = (state_spec, state_spec, run_spec)
+        metric_spec = {"loss": run_spec, "ic": (P(axis_name, SEED_AXIS)
+                                                if self._seed_axis
+                                                else run_spec)}
+        if not ensemble:
+            metric_spec.update(grad_norm=run_spec, mse=run_spec)
+        self._jit_epoch = ledger_jit(
+            "stack_epoch",
+            shard_map_compat(
+                self._epoch_impl,
+                mesh=mesh,
+                in_specs=(carry_spec, P(), batch_spec, batch_spec,
+                          batch_spec, run_spec, run_spec, run_spec,
+                          hp_spec, P()),
+                out_specs=(carry_spec, metric_spec),
+                check_vma=False,
+            ),
+            donate_argnums=donate)
+        self._state_spec = state_spec
+
+    # ---- per-run-operand optimizer (the hyper step) ------------------
+
+    def _build_hyper_tx(self, o, steps_per_epoch: int) -> None:
+        """Mirror of the inner bundle's optax chain with the per-run
+        hyperparameters factored out as operands. The baked chain is
+        ``chain(clip_by_global_norm, adamw|lamb(schedule, wd))``; the
+        mirror applies the SAME transforms in the SAME order — clip,
+        scale_by_adam, ``u + wd·p``, (trust ratio for lamb,)
+        ``u · (−lr·unit_schedule(count))`` — where ``unit_schedule`` is
+        the baked warmup-cosine with peak 1.0 and end 0.1 (optax's value
+        is linear in the peak: init 0, alpha = end/peak = 0.1 either
+        way), so ``lr ⊗ unit(count)`` equals ``schedule(count)`` to the
+        bit when ``lr`` equals the baked peak. The ``stacked`` lane's
+        bit-identity tests are the proof, not this comment."""
+        total_steps = max(1, steps_per_epoch * o.epochs)
+        self._unit_sched = optax.warmup_cosine_decay_schedule(
+            0.0, 1.0, min(o.warmup_steps, total_steps // 2),
+            total_steps, end_value=0.1)
+        self._clip = optax.clip_by_global_norm(o.grad_clip)
+        if o.optimizer == "adamw":
+            self._adam = optax.scale_by_adam()
+            self._trust = None
+        elif o.optimizer == "lamb":
+            # optax.lamb's defaults differ from adamw's: eps=1e-6.
+            self._adam = optax.scale_by_adam(eps=1e-6)
+            self._trust = optax.scale_by_trust_ratio()
+        else:
+            raise ValueError(
+                f"per-run-operand sweep supports adamw|lamb, got "
+                f"{o.optimizer!r}")
+
+    def _hyper_update(self, grads, opt_state, params, lr, wd):
+        """One optimizer update with (lr, wd) as traced per-run scalars,
+        consuming/producing the baked chain's opt_state tree positionally
+        — (clip, (adam, decay, [trust,] schedule)) — so states init'd by
+        the inner ``tx.init`` (and checkpoints written from them) stay
+        structure-compatible with the sequential path."""
+        clip_s, chain_s = opt_state
+        u, clip_s = self._clip.update(grads, clip_s)
+        u, adam_s = self._adam.update(u, chain_s[0], params)
+        u = jax.tree.map(lambda g, p: g + wd * p, u, params)
+        if self._trust is not None:
+            u, trust_s = self._trust.update(u, chain_s[2], params)
+        sched_s = chain_s[-1]
+        step_size = -1 * (lr * self._unit_sched(sched_s.count))
+        u = jax.tree.map(
+            lambda g: jnp.array(step_size, dtype=g.dtype) * g, u)
+        sched_s = type(sched_s)(
+            count=optax.safe_int32_increment(sched_s.count))
+        if self._trust is not None:
+            chain_s = (adam_s, chain_s[1], trust_s, sched_s)
+        else:
+            chain_s = (adam_s, chain_s[1], sched_s)
+        return u, (clip_s, chain_s)
+
+    def _hyper_multi_step(self, state: TrainState, dev: dict, fi, ti, w,
+                          lr, wd, axis=None):
+        """K training steps of ONE run in one scan, with this run's
+        (lr, wd) operands applied by the mirrored chain — the hyper twin
+        of ``TrainerPrograms._multi_step_impl`` (gradients come from the
+        same ``_grads_impl``, so the loss/gather/psum path is shared)."""
+        def body(st, batch):
+            f, t, ww = batch
+            loss, grads = self.inner._grads_impl(st, dev, f, t, ww,
+                                                 axis=axis)
+            updates, opt_state = self._hyper_update(
+                grads, st.opt_state, st.params, lr, wd)
+            params = optax.apply_updates(st.params, updates)
+            gnorm = optax.global_norm(grads)
+            return TrainState(params, opt_state, st.step + 1, st.rng), {
+                "loss": loss, "grad_norm": gnorm}
+
+        return jax.lax.scan(body, state, (fi, ti, w))
+
+    # ---- the fused epoch program ------------------------------------
+
+    def _epoch_impl(self, carry, dev: dict, fi, ti, w, vfi, vti, vw, hp,
+                    epoch):
+        """One stacked epoch: train all live runs, evaluate every run,
+        update the device-side control state. ``epoch`` is a traced i32
+        scalar (no retrace per epoch); ``hp`` is the (possibly empty)
+        dict of [R] per-run hyperparameter operands. Under the stack
+        mesh this body runs per shard on the local run block; all arrays
+        below carry the LOCAL run axis."""
+        state, best_params, ctrl = carry
+        inner = self.inner
+        live = ctrl.live
+
+        if self.hyper:
+            ax = (self._data_axis,) if self._data_axis else None
+            multi = lambda st, f, t, ww, lr, wd: self._hyper_multi_step(
+                st, dev, f, t, ww, lr, wd, axis=ax)
+            new_state, ms = scan_in_blocks(
+                jax.vmap(multi), self.block,
+                (state, fi, ti, w, hp["lr"], hp["weight_decay"]))
+        elif self.ensemble:
+            multi = lambda st, f, t, ww: inner._multi_step_impl(
+                st, dev, f, t, ww)
+            new_state, ms = scan_in_blocks(jax.vmap(multi), self.block,
+                                           (state, fi, ti, w))
+        else:
+            ax = (self._data_axis,) if self._data_axis else None
+            multi = lambda st, f, t, ww: inner._multi_step_impl(
+                st, dev, f, t, ww, axis=ax)
+            new_state, ms = scan_in_blocks(jax.vmap(multi), self.block,
+                                           (state, fi, ti, w))
+
+        # Bit-freeze stopped runs: a SELECT back to the input state, not
+        # a zero-weight arithmetic step — Adam moment decay, weight decay
+        # and the step counter would all still move under zeroed
+        # gradients, and the parity contract is bit-frozen params.
+        def sel_live(n, o):
+            m = live.reshape(live.shape + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+
+        state = jax.tree.map(sel_live, new_state, state)
+
+        # Chained per-run validation sweep on the post-select params (a
+        # frozen run re-evaluates its frozen params — masked out of the
+        # control update below, so only live runs' ICs matter).
+        counts = vw.sum(axis=-1)  # [R, M] f32
+        if self.ensemble:
+            seed_fwd = jax.vmap(inner.inner._forward_impl,
+                                in_axes=(0, None, None, None, None))
+
+            def run_eval(p, vf, vt, vww):
+                _, ic, _ = seed_fwd(p, dev, vf, vt, vww)
+                return ic  # [S_local, M]
+
+            ic = jax.vmap(run_eval)(state.params, vfi, vti, vw)
+            per_seed = ((ic * counts[:, None, :]).sum(-1)
+                        / counts.sum(-1)[:, None])  # [R, S_local]
+            if self._seed_axis:
+                val_ic = (jax.lax.psum(per_seed.sum(axis=1),
+                                       self._seed_axis)
+                          / inner.n_seeds)
+            else:
+                val_ic = per_seed.mean(axis=1)
+            k_steps = fi.shape[1]
+            loss_sum = ms["loss"].sum(axis=(1, 2))
+            if self._seed_axis:
+                loss_sum = jax.lax.psum(loss_sum, self._seed_axis)
+            metrics = {"loss": loss_sum / (k_steps * inner.n_seeds),
+                       "ic": ic}
+        else:
+            def run_eval(p, vf, vt, vww):
+                _, ic, mse = inner._forward_impl(p, dev, vf, vt, vww)
+                return ic, mse
+
+            ic, mse = jax.vmap(run_eval)(state.params, vfi, vti, vw)
+            val_ic = (ic * counts).sum(-1) / counts.sum(-1)  # [R] f32
+            metrics = {"loss": ms["loss"].mean(axis=1),
+                       "grad_norm": ms["grad_norm"].mean(axis=1),
+                       "ic": ic, "mse": mse}
+
+        # Device-side FitHarness: same comparisons, vectorized. A run
+        # improves strictly (val_ic > best_ic, -inf start ⇒ epoch 0
+        # always improves), otherwise its patience counter advances; a
+        # run whose counter reaches patience leaves the live set for
+        # every later epoch — including a speculative overrun epoch,
+        # which therefore cannot move any state.
+        improved = live & (val_ic > ctrl.best_ic)
+        best_ic = jnp.where(improved, val_ic, ctrl.best_ic)
+        best_epoch = jnp.where(improved, epoch, ctrl.best_epoch)
+        bad = jnp.where(improved, 0,
+                        jnp.where(live, ctrl.bad_epochs + 1,
+                                  ctrl.bad_epochs))
+
+        def sel_best(n, o):
+            m = improved.reshape(improved.shape + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+
+        best_params = jax.tree.map(sel_best, state.params, best_params)
+        ctrl = RunCtrl(live & (bad < self.patience), best_ic, best_epoch,
+                       bad)
+        return (state, best_params, ctrl), metrics
+
+
+class _StackHarness:
+    """Duck-typed FitHarness shell for ``pipeline.run_fit_epochs``:
+    epoch accounting only. Early stopping lives DEVICE-SIDE in the
+    stacked control state; the ``finish`` callback sets ``all_dead``
+    from the fetched live mask, and ``end_epoch`` just reports it (no
+    checkpointing — run checkpoints are unstacked at finalize)."""
+
+    def __init__(self, epochs: int):
+        self.epochs = epochs
+        self.all_dead = False
+        self._epoch = -1
+
+    def next_epoch(self) -> Optional[int]:
+        nxt = self._epoch + 1
+        if nxt >= self.epochs or self.all_dead:
+            return None
+        self._epoch = nxt
+        return nxt
+
+    def end_epoch(self, epoch, step, state_dict, val_ic) -> bool:
+        return self.all_dead
+
+    @property
+    def last_epoch(self) -> int:
+        return self._epoch
+
+
+def _normalized_cfg(cfg: RunConfig) -> RunConfig:
+    """A run config with every legally-varying field zeroed: seed (each
+    run draws its own init/data streams), the per-run-operand
+    hyperparameters, and pure labels. Two configs may share a stack iff
+    they normalize equal — anything else reaching a traced program as a
+    constant would silently train the wrong program."""
+    return dataclasses.replace(
+        cfg, seed=0, name="",
+        optim=dataclasses.replace(cfg.optim, lr=0.0, weight_decay=0.0))
+
+
+class StackedRuns:
+    """Driver for one stacked sweep over R independent same-shape runs.
+
+    Construction validates every stacking precondition (raising
+    :class:`StackUnavailable` on data-dependent mismatches), binds ONE
+    trainer (programs + resident panel through the reuse caches), builds
+    per-run samplers with the exact per-run PRNG streams, stages the
+    per-run hyperparameter operands, and fetches the stacked epoch
+    program through the program cache. :meth:`fit` trains the stack
+    through the PR 3 pipeline driver and unstacks per-run results
+    (histories, best checkpoints); adapters add their own per-run work
+    (the walk-forward's per-fold predictions) via the ``per_run``
+    callback so its cost lands inside the run's reuse delta.
+
+    ``kind`` labels the run axis: "fold" keeps the walk-forward
+    adapter's axis name, telemetry span names ("foldstack_fit",
+    "fold_stopped"), program-key family and summary keys exactly as
+    PR 5 shipped them; any other kind uses the generic 'stack' axis,
+    "stack_fit"/"run_stopped" telemetry and ``stacked_program_key``.
+    """
+
+    def __init__(self, run_cfgs: Sequence[RunConfig],
+                 run_splits: Sequence[PanelSplits], panel: Panel, *,
+                 kind: str = "config",
+                 run_dirs: Optional[Sequence[Optional[str]]] = None,
+                 echo: bool = False):
+        from lfm_quant_tpu.train import reuse
+        from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+        from lfm_quant_tpu.train.loop import Trainer
+
+        if len(run_cfgs) < 2:
+            raise StackUnavailable(
+                f"run-stacking needs >= 2 runs, got {len(run_cfgs)}")
+        if len(run_splits) != len(run_cfgs):
+            raise ValueError("run_cfgs and run_splits length mismatch")
+        cfg = run_cfgs[0]
+        ref = _normalized_cfg(cfg)
+        for k, c in enumerate(run_cfgs[1:], 1):
+            if _normalized_cfg(c) != ref:
+                raise StackUnavailable(
+                    f"run {k}'s config differs beyond the per-run axes "
+                    f"(seed, {', '.join(HYPER_KEYS)}) — a field that "
+                    "reaches the traced program as a constant cannot "
+                    "vary within one stack")
+        self.kind = kind
+        self.fold_kind = kind == "fold"
+        self.axis_name = FOLD_AXIS if self.fold_kind else STACK_AXIS
+        self.cfg = cfg
+        self.panel = panel
+        self.run_cfgs = list(run_cfgs)
+        self.splits = list(run_splits)
+        self.run_count = len(run_cfgs)
+        self.run_dirs = (list(run_dirs) if run_dirs is not None
+                         else [None] * self.run_count)
+        self.checkpointing = any(rd for rd in self.run_dirs)
+        self.ensemble = cfg.n_seeds > 1
+        self.het = cfg.is_heteroscedastic
+        self.window = cfg.data.window
+        d = cfg.data
+        R = self.run_count
+
+        lrs = [c.optim.lr for c in run_cfgs]
+        wds = [c.optim.weight_decay for c in run_cfgs]
+        self.hyper = len(set(lrs)) > 1 or len(set(wds)) > 1
+        self.hyper_keys = HYPER_KEYS if self.hyper else ()
+        if self.hyper:
+            if self.ensemble:
+                raise StackUnavailable(
+                    "per-run hyperparameter operands are single-seed "
+                    "only for now (n_seeds > 1 configs stack uniformly "
+                    "or run sequentially)")
+            if cfg.optim.optimizer not in ("adamw", "lamb"):
+                raise StackUnavailable(
+                    f"per-run-operand sweep supports adamw|lamb, got "
+                    f"{cfg.optim.optimizer!r}")
+
+        # ONE trainer, bound to run 0: supplies the compiled inner
+        # programs, the resolved gather/panel geometry, predict(), and
+        # the state-commit machinery — all through the reuse caches.
+        self.trainer = (EnsembleTrainer if self.ensemble else Trainer)(
+            run_cfgs[0], self.splits[0], run_dir=None, echo=echo)
+        n_seq = getattr(self.trainer, "_n_seq", 1)
+        if n_seq > 1:
+            raise StackUnavailable(
+                "run-stacking does not compose with sequence "
+                "parallelism (the seq axis' ring collectives assume "
+                "innermost ICI placement)")
+
+        # Per-run samplers with the run's own seed and anchor range —
+        # the exact streams the sequential run would consume.
+        if self.ensemble:
+            self.run_samplers = [
+                [DateBatchSampler(
+                    panel, d.window, d.dates_per_batch, d.firms_per_date,
+                    seed=rc.seed + s, min_valid_months=d.min_valid_months,
+                    date_range=sp.train_range, engine=d.sampler_engine)
+                 for s in range(cfg.n_seeds)]
+                for rc, sp in zip(run_cfgs, self.splits)
+            ]
+            steps = [min(s.batches_per_epoch() for s in per_run)
+                     for per_run in self.run_samplers]
+        else:
+            self.run_samplers = [
+                DateBatchSampler(
+                    panel, d.window, d.dates_per_batch, d.firms_per_date,
+                    seed=rc.seed, min_valid_months=d.min_valid_months,
+                    date_range=sp.train_range, engine=d.sampler_engine)
+                for rc, sp in zip(run_cfgs, self.splits)
+            ]
+            steps = [s.batches_per_epoch() for s in self.run_samplers]
+        if len(set(steps)) != 1:
+            raise StackUnavailable(
+                f"runs disagree on steps-per-epoch {steps} — stacking "
+                "requires the same-shape schedule")
+        self.steps = steps[0]
+
+        # Per-run validation sweeps, stacked. The eval batch width is
+        # panel-wide (windows.py _eval_bf), so only the month COUNT can
+        # differ — runs that disagree degrade to sequential.
+        val_samplers = [
+            DateBatchSampler(panel, d.window, 1, d.firms_per_date,
+                             seed=rc.seed,
+                             min_valid_months=d.min_valid_months,
+                             min_cross_section=1, date_range=sp.val_range)
+            for rc, sp in zip(run_cfgs, self.splits)
+        ]
+        months = [vs.stacked_eval_months() for vs in val_samplers]
+        if len(set(months)) != 1:
+            raise StackUnavailable(
+                f"runs disagree on eligible val months {months} — "
+                "cannot stack the validation sweeps")
+        vbs = [vs.stacked_cross_sections() for vs in val_samplers]
+        self.counts = np.stack([b.weight.sum(axis=1) for b in vbs])
+
+        # Stack mesh: the run axis composed outside the trainer's own
+        # seed/data axes (the LFM_FOLDSTACK_SHARDS / LFM_STACK_SHARDS
+        # knobs cap/disable it per kind).
+        shards = (reuse.foldstack_shards() if self.fold_kind
+                  else reuse.stack_shards())
+        self.mesh = make_stack_mesh(R, self.trainer.mesh, shards,
+                                    axis_name=self.axis_name)
+        n_axis = (self.mesh.shape[self.axis_name]
+                  if self.mesh is not None else 1)
+        blk = reuse.stack_block()
+        r_local = R // n_axis
+        if blk >= r_local:
+            blk = 0  # whole local stack in one vmap — the unblocked trace
+        elif blk and r_local % blk:
+            warnings.warn(
+                f"LFM_STACK_BLOCK={blk} does not divide the per-shard "
+                f"run count {r_local}; running unblocked", stacklevel=3)
+            blk = 0
+        self.stack_block = blk
+
+        inner = self.trainer.programs
+        patience = cfg.optim.early_stop_patience
+        if self.fold_kind:
+            self.program_key = reuse.foldstack_program_key(
+                self.trainer.program_key, self.mesh, R, patience, blk)
+        else:
+            self.program_key = reuse.stacked_program_key(
+                self.trainer.program_key, self.mesh, R, patience, kind,
+                self.hyper_keys, blk)
+        self.programs = reuse.get_programs(
+            self.program_key,
+            lambda: StackedPrograms(
+                inner, self.mesh, R, patience, self.ensemble,
+                axis_name=self.axis_name, hyper_keys=self.hyper_keys,
+                block=blk, steps_per_epoch=self.steps,
+                optim_cfg=cfg.optim))
+        # ONE spec source: the programs' shard_map in_specs — H2D staging
+        # placed with anything else would silently reshard per dispatch.
+        self._batch_spec = self.programs._batch_spec
+
+        if self.mesh is not None:
+            t_mesh = self.trainer.mesh
+            if (t_mesh is not None
+                    and {dv.id for dv in self.mesh.devices.flat}
+                    == {dv.id for dv in t_mesh.devices.flat}):
+                # Same device SET (e.g. the inner mesh already spans all
+                # devices, so the stack axis degraded to 1): replicated
+                # placement is device-set-invariant, so the trainer's
+                # resident panel serves the stack mesh as-is — no second
+                # full-panel H2D, no duplicate HBM copy for the sweep.
+                self.dev = self.trainer.dev
+            else:
+                gather_impl = (self.trainer.inner._gather_impl
+                               if self.ensemble
+                               else self.trainer._gather_impl)
+                self.dev = cached_device_panel(
+                    panel, self.mesh,
+                    compute_dtype=(jnp.bfloat16 if cfg.model.bf16
+                                   else None),
+                    raw=False, lane_pad=gather_impl == "pallas")
+        else:
+            self.dev = self.trainer.dev  # same placement — zero extra H2D
+
+        self._vargs = tuple(
+            self._put(np.stack([getattr(b, f) for b in vbs]),
+                      P(self.axis_name))
+            for f in ("firm_idx", "time_idx", "weight"))
+        # Per-run hyperparameter operands: [R] f32, placed ONCE on the
+        # run axis — every epoch dispatch reuses the same small arrays
+        # (never donated; only the carry is).
+        self._hp = {}
+        if self.hyper:
+            self._hp = {
+                "lr": self._put(np.asarray(lrs, np.float32),
+                                P(self.axis_name)),
+                "weight_decay": self._put(np.asarray(wds, np.float32),
+                                          P(self.axis_name)),
+            }
+
+    # ---- placement ---------------------------------------------------
+
+    def _put(self, a, spec):
+        if self.mesh is None:
+            return jnp.asarray(a)
+        return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+    def init_carry(self):
+        """Fresh stacked carry: per-run independent init draws (exact
+        sequential parity — see ``init_stacked_states``), best-params
+        copies, and the all-live control state — committed to the stack
+        mesh."""
+        state = self.trainer.init_stacked_states(
+            [rc.seed for rc in self.run_cfgs])
+        best_params = jax.tree.map(jnp.copy, state.params)
+        R = self.run_count
+        ctrl = RunCtrl(
+            live=jnp.ones((R,), bool),
+            best_ic=jnp.full((R,), -jnp.inf, jnp.float32),
+            best_epoch=jnp.full((R,), -1, jnp.int32),
+            bad_epochs=jnp.zeros((R,), jnp.int32),
+        )
+        carry = (state, best_params, ctrl)
+        if self.mesh is None:
+            return carry
+        state_spec = getattr(self.programs, "_state_spec",
+                             P(self.axis_name))
+
+        def shard_of(spec):
+            return lambda x: NamedSharding(
+                self.mesh,
+                spec if getattr(x, "ndim", 0) >= len(spec)
+                else P(self.axis_name))
+
+        shardings = (jax.tree.map(shard_of(state_spec), state),
+                     jax.tree.map(shard_of(state_spec), best_params),
+                     jax.tree.map(shard_of(P(self.axis_name)), ctrl))
+        return jax.device_put(carry, shardings)
+
+    # ---- epoch callbacks (pipeline.run_fit_epochs contract) ----------
+
+    def build_epoch(self, epoch: int):
+        """Host sampling + H2D staging for one stacked epoch — runs on
+        the prefetch thread under ``LFM_ASYNC`` (pure deterministic reads
+        per (seed, epoch), the same thread-safety contract as the
+        sequential build)."""
+        with telemetry.span("sample", epoch=epoch, runs=self.run_count):
+            if self.ensemble:
+                stacks = []
+                for per_run in self.run_samplers:
+                    per_seed = [s.stacked_epoch(epoch) for s in per_run]
+                    # Same loud contract as stack_fold_epochs: the
+                    # truncate-to-min-K the sequential ensemble applies
+                    # is only legal down to the init-time steps count —
+                    # a shorter member epoch would silently train this
+                    # run on a partial epoch.
+                    if min(b.firm_idx.shape[0] for b in per_seed) \
+                            < self.steps:
+                        raise ValueError(
+                            "stacked ensemble epoch shorter than the "
+                            f"{self.steps}-step schedule — member "
+                            "samplers drifted out of shape")
+                    stacks.append(tuple(
+                        np.stack([getattr(b, f)[:self.steps]
+                                  for b in per_seed], axis=1)
+                        for f in ("firm_idx", "time_idx", "weight")))
+                fi, ti, w = (np.stack([s[i] for s in stacks])
+                             for i in range(3))
+            else:
+                b = stack_fold_epochs(self.run_samplers, epoch)
+                fi, ti, w = b.firm_idx, b.time_idx, b.weight
+            fm = float(w.sum()) * self.window
+        with telemetry.span("h2d", epoch=epoch):
+            spec = self._batch_spec
+            args = tuple(self._put(a, spec) for a in (fi, ti, w))
+        return args + (jnp.asarray(epoch, jnp.int32),), fm
+
+    def dispatch_epoch(self, carry, args):
+        """Queue one stacked epoch (train + eval + control in ONE jitted
+        dispatch). The fetched scalars are COPIES: the next epoch's
+        dispatch donates the carry, and a fetched value must never alias
+        a donated buffer (same rule as the sequential pipeline)."""
+        fi, ti, w, epoch = args
+        carry, vals = self.programs._jit_epoch(
+            carry, self.dev, fi, ti, w, *self._vargs, self._hp, epoch)
+        state, _, ctrl = carry
+        vals = dict(vals, step=jnp.copy(state.step),
+                    live=jnp.copy(ctrl.live))
+        return carry, vals
+
+    # ---- the full sweep ---------------------------------------------
+
+    def run_state(self, k: int) -> TrainState:
+        """Run ``k``'s final TrainState, unstacked from the trained
+        carry — best-tracked params when the run checkpoints (its dir's
+        ckpt/best is restored downstream exactly like a sequential
+        run's), the last recorded state otherwise (a sequential ``fit``
+        without a run dir has no best line to restore and ends on the
+        last epoch's state — mirror that, or stacking would silently
+        flip forecasts for non-checkpointing callers)."""
+        state, best_params = self._final_state, self._best_params
+        src = best_params if self.run_dirs[k] else state.params
+        return TrainState(
+            params=jax.tree.map(lambda x: x[k], src),
+            opt_state=jax.tree.map(lambda x: x[k], state.opt_state),
+            step=state.step[k],
+            rng=state.rng[k],
+        )
+
+    def fit(self, per_run: Optional[Callable[[int], None]] = None
+            ) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+        """Train the stack, unstack per-run results. Returns
+        ``(run_summaries, stack_summary)``; ``per_run(k)`` (when given)
+        executes inside run k's reuse-delta window after its checkpoint
+        unstack — the walk-forward adapter predicts there."""
+        from lfm_quant_tpu.train import pipeline
+        from lfm_quant_tpu.train.checkpoint import (CheckpointManager,
+                                                    fold_slice)
+
+        R = self.run_count
+        snap_stack = REUSE_COUNTERS.snapshot()
+        histories: List[List[Dict[str, Any]]] = [[] for _ in range(R)]
+        loggers = [MetricsLogger(rd) for rd in self.run_dirs]
+        live_mask = np.ones(R, bool)
+        harness = _StackHarness(self.cfg.optim.epochs)
+        timer = StepTimer()
+        stop_name = "fold_stopped" if self.fold_kind else "run_stopped"
+        stop_key = "fold" if self.fold_kind else "run"
+
+        def finish(epoch, host, fm):
+            nonlocal live_mask
+            live_in = live_mask
+            ic = np.asarray(host["ic"])
+            live_ics = []
+            for r in range(R):
+                if not live_in[r]:
+                    continue
+                if self.ensemble:
+                    per_seed = ((ic[r] * self.counts[r]).sum(axis=1)
+                                / self.counts[r].sum())
+                    val_ic = float(per_seed.mean())
+                    rec = loggers[r].log(
+                        int(np.asarray(host["step"][r]).reshape(-1)[0]),
+                        epoch=epoch,
+                        train_loss=float(host["loss"][r]),
+                        val_ic=val_ic,
+                        val_ic_std=float(per_seed.std()),
+                        firm_months_per_sec=timer.throughput(),
+                    )
+                else:
+                    # f64 np.average — the exact aggregation finish()
+                    # applies on the sequential path, over the same
+                    # per-month ICs, so recorded histories match.
+                    val_ic = float(np.average(ic[r],
+                                              weights=self.counts[r]))
+                    rec = loggers[r].log(
+                        int(host["step"][r]),
+                        epoch=epoch,
+                        train_loss=float(host["loss"][r]),
+                        grad_norm=float(host["grad_norm"][r]),
+                        val_ic=val_ic,
+                        val_mse=float(host["mse"][r]),
+                        firm_months_per_sec=timer.throughput(),
+                    )
+                histories[r].append(rec)
+                live_ics.append(val_ic)
+            new_live = np.asarray(host["live"])
+            for r in range(R):
+                if live_in[r] and not new_live[r]:
+                    telemetry.instant(stop_name, epoch=epoch,
+                                      **{stop_key: r})
+            live_mask = new_live
+            harness.all_dead = not bool(new_live.any())
+            step = int(np.max(np.asarray(host["step"])))
+            return step, (float(np.mean(live_ics)) if live_ics else 0.0)
+
+        mesh_items = (list(self.mesh.shape.items())
+                      if self.mesh is not None else None)
+        if self.fold_kind:
+            span_name, span_kw = "foldstack_fit", dict(
+                fold_count=R, fold_mesh=mesh_items)
+        else:
+            span_name, span_kw = "stack_fit", dict(
+                kind=self.kind, run_count=R, stack_mesh=mesh_items,
+                hyper=list(self.hyper_keys), stack_block=self.stack_block)
+        with telemetry.span(span_name, cat="fit", **span_kw) as sp:
+            carry, overrun = pipeline.run_fit_epochs(
+                harness, self.init_carry(), build=self.build_epoch,
+                dispatch=self.dispatch_epoch, finish=finish, timer=timer,
+                checkpointing=False)
+            state, best_params, ctrl = carry
+            host_ctrl = jax.device_get(ctrl)
+            sp.set(epochs_run=[len(h) for h in histories],
+                   best_epochs=[int(e) for e in host_ctrl.best_epoch],
+                   overrun=overrun is not None)
+        for lg in loggers:
+            lg.close()
+        self._final_state, self._best_params = state, best_params
+        self.host_ctrl = host_ctrl
+
+        host_best = host_aux = None
+        if self.checkpointing:
+            host_best = jax.device_get(best_params)
+            host_aux = jax.device_get({"opt_state": state.opt_state,
+                                       "step": state.step,
+                                       "rng": state.rng})
+        stack_reuse = {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in REUSE_COUNTERS.delta(snap_stack).items()}
+
+        run_summaries: List[Dict[str, Any]] = []
+        for r in range(R):
+            snap_run = REUSE_COUNTERS.snapshot()
+            best_epoch = int(host_ctrl.best_epoch[r])
+            best_val_ic = (histories[r][best_epoch]["val_ic"]
+                           if 0 <= best_epoch < len(histories[r])
+                           else float(host_ctrl.best_ic[r]))
+            best_step = (best_epoch + 1) * self.steps
+            if self.run_dirs[r]:
+                # Unstack this run's ckpt/best line so the run dir is
+                # loadable exactly like a sequential run's. The params
+                # are the device-tracked best; the aux leaves come from
+                # the final state (predict/backtest only consume
+                # params). The step leaf keeps the FINAL state's SHAPE
+                # — scalar for a Trainer, [S] for the ensemble's
+                # vmapped init — with the best step's value, or Orbax
+                # restore would reject the ensemble's abstract tree.
+                step_leaf = np.full_like(
+                    np.asarray(fold_slice(host_aux["step"], r)), best_step)
+                mgr = CheckpointManager(
+                    os.path.join(self.run_dirs[r], "ckpt", "best"),
+                    max_to_keep=1)
+                mgr.save(best_step, {
+                    "params": fold_slice(host_best, r),
+                    "opt_state": fold_slice(host_aux["opt_state"], r),
+                    "step": step_leaf,
+                    "rng": host_aux["rng"][r],
+                }, wait=True)
+                mgr.close()
+            if per_run is not None:
+                per_run(r)
+            run_summaries.append({
+                "best_val_ic": best_val_ic,
+                "best_epoch": best_epoch,
+                "epochs_run": len(histories[r]),
+                "history": histories[r],
+                "reuse": {k: (round(v, 4) if isinstance(v, float) else v)
+                          for k, v in
+                          REUSE_COUNTERS.delta(snap_run).items()},
+            })
+
+        stack_summary: Dict[str, Any] = {"enabled": True}
+        if self.fold_kind:
+            stack_summary.update(fold_count=R, fold_mesh=mesh_items)
+        else:
+            stack_summary.update(kind=self.kind, run_count=R,
+                                 stack_mesh=mesh_items,
+                                 hyper=list(self.hyper_keys),
+                                 stack_block=self.stack_block)
+        stack_summary.update(
+            steps_per_epoch=self.steps,
+            lookahead_overrun=overrun is not None,
+            reuse=stack_reuse,
+        )
+        return run_summaries, stack_summary
+
+
+# ---- the config-sweep workload ------------------------------------------
+
+
+def parse_sweep_grid(spec: str) -> List[Dict[str, float]]:
+    """``"lr=1e-3,5e-4;weight_decay=1e-4,0"`` → the cartesian grid as a
+    list of per-config override dicts (the ``--sweep-grid`` CLI format:
+    semicolon-separated axes, comma-separated values). Only the
+    per-run-operand hyperparameters (:data:`HYPER_KEYS`) are legal axes
+    — anything else changes the traced program and must be swept as
+    separate stacks."""
+    axes: List[Tuple[str, List[float]]] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, vals = part.partition("=")
+        name = name.strip()
+        if not eq or name not in HYPER_KEYS:
+            raise ValueError(
+                f"sweep axis {name!r} is not sweepable as a per-run "
+                f"operand; supported: {', '.join(HYPER_KEYS)}")
+        if any(name == n for n, _ in axes):
+            raise ValueError(f"duplicate sweep axis {name!r}")
+        values = [float(v) for v in vals.split(",") if v.strip()]
+        if not values:
+            raise ValueError(f"sweep axis {name!r} has no values")
+        axes.append((name, values))
+    if not axes:
+        raise ValueError("empty sweep grid spec")
+    grid: List[Dict[str, float]] = [{}]
+    for name, values in axes:
+        grid = [dict(g, **{name: v}) for g in grid for v in values]
+    return grid
+
+
+def sweep_stacked_enabled() -> bool:
+    """``LFM_SWEEP_STACKED=0`` forces a config sweep down the
+    sequential per-config path (the parity/A-B reference); default on —
+    the stacked engine IS the point of the sweep workload."""
+    return os.environ.get("LFM_SWEEP_STACKED", "1") != "0"
+
+
+def run_config_sweep(cfg: RunConfig, grid: Sequence[Dict[str, float]],
+                     panel: Optional[Panel] = None,
+                     out_dir: Optional[str] = None, echo: bool = False,
+                     stacked: Optional[bool] = None) -> Dict[str, Any]:
+    """Train every config of an LR × weight-decay ``grid`` on the run
+    config's train/val split — as ONE stacked compiled program when the
+    stack preconditions hold (``StackedRuns`` with per-run hyperparameter
+    operands), else as sequential per-config fits (also the explicit
+    reference via ``stacked=False`` / ``LFM_SWEEP_STACKED=0``). A
+    data-dependent :class:`StackUnavailable` degrades to sequential with
+    a warning, a ``stack_degraded`` telemetry instant and a
+    ``stack_degrades`` counter bump — visible in
+    ``scripts/trace_report.py``, never silent.
+
+    Per-config run dirs land under ``out_dir/config_<i>`` (config.json +
+    metrics.jsonl + ckpt/best — loadable by ``load_trainer`` exactly
+    like a sequential run), and ``sweep_summary.json`` ranks the grid.
+    Returns the summary dict (per-config best val ICs, best epochs,
+    ``best_index``/``best_config``, and the stack's reuse delta)."""
+    import json
+
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+    from lfm_quant_tpu.train.loop import (Trainer, default_split_dates,
+                                          resolve_panel)
+    from lfm_quant_tpu.train.walkforward import write_fold_run_dir
+
+    grid = [dict(g) for g in grid]
+    if not grid:
+        raise ValueError("empty sweep grid")
+    bad = sorted(set().union(*(set(g) for g in grid)) - set(HYPER_KEYS))
+    if bad:
+        raise ValueError(
+            f"unsupported sweep axes {bad}; per-run operands cover "
+            f"{', '.join(HYPER_KEYS)}")
+    if stacked is None:
+        stacked = sweep_stacked_enabled()
+    run_cfgs = [
+        dataclasses.replace(cfg, optim=dataclasses.replace(cfg.optim, **g))
+        for g in grid
+    ]
+    if panel is None:
+        panel = resolve_panel(cfg.data)
+    train_end, val_end = default_split_dates(panel, cfg.data)
+    splits = PanelSplits.by_date(panel, train_end, val_end,
+                                 train_start=cfg.data.train_start)
+    R = len(grid)
+    ensemble = cfg.n_seeds > 1
+    run_dirs: List[Optional[str]] = [
+        os.path.join(out_dir, f"config_{i:03d}") if out_dir else None
+        for i in range(R)
+    ]
+    for i, rd in enumerate(run_dirs):
+        if rd:
+            write_fold_run_dir(run_cfgs[i], rd, train_end, val_end,
+                               cfg.data.train_start, ensemble)
+
+    run_sums = None
+    stack_info = None
+    with telemetry.span("config_sweep", cat="fit", n_configs=R):
+        if stacked and R >= 2:
+            try:
+                eng = StackedRuns(run_cfgs, [splits] * R, panel,
+                                  kind="config", run_dirs=run_dirs,
+                                  echo=echo)
+                run_sums, stack_info = eng.fit()
+            except StackUnavailable as e:
+                warnings.warn(
+                    f"stacked config sweep unavailable ({e}); running "
+                    "the configs sequentially", stacklevel=2)
+                telemetry.instant("stack_degraded", kind="config",
+                                  reason=str(e))
+                telemetry.COUNTERS.bump("stack_degrades")
+        if run_sums is None:
+            run_sums = []
+            for rc, rd in zip(run_cfgs, run_dirs):
+                trainer = (EnsembleTrainer if ensemble else Trainer)(
+                    rc, splits, run_dir=rd, echo=echo)
+                fit = trainer.fit()
+                run_sums.append({
+                    "best_val_ic": fit["best_val_ic"],
+                    "best_epoch": fit["best_epoch"],
+                    "epochs_run": fit["epochs_run"],
+                    "history": fit["history"],
+                })
+
+    runs = [{
+        "config": grid[i],
+        "run_dir": run_dirs[i],
+        "best_val_ic": run_sums[i]["best_val_ic"],
+        "best_epoch": run_sums[i]["best_epoch"],
+        "epochs_run": run_sums[i]["epochs_run"],
+    } for i in range(R)]
+    best_index = int(max(range(R), key=lambda i: runs[i]["best_val_ic"]))
+    summary = {
+        "n_configs": R,
+        "grid": grid,
+        "train_end": train_end,
+        "val_end": val_end,
+        "runs": runs,
+        "stacked": stack_info,
+        "best_index": best_index,
+        "best_config": grid[best_index],
+        "best_val_ic": runs[best_index]["best_val_ic"],
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "sweep_summary.json"), "w") as fh:
+            json.dump(summary, fh, indent=2)
+    return summary

@@ -98,7 +98,7 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
     """Roll the raw artifacts up into the printed/JSON report dict."""
     spans = run["spans"]
     fits = [s for s in spans
-            if s.get("name") in ("fit", "foldstack_fit")]
+            if s.get("name") in ("fit", "foldstack_fit", "stack_fit")]
     epochs = [s for s in spans if s.get("name") == "epoch"]
     runs = [s for s in spans if s.get("name") == "run"]
 
@@ -213,6 +213,44 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
             "early_stops": [{"fold": a.get("fold"), "epoch": a.get("epoch")}
                             for a in stops],
         }
+    # Stacked-sweep rollup (the generic stacked-run engine,
+    # train/stacked.py): per-run epoch counts / best epochs from the
+    # stack_fit span args plus the per-run stop marks — and, critically,
+    # every degrade-to-sequential event (the ``stack_degraded`` instants
+    # + ``stack_degrades`` counter the fold/config drivers emit), so a
+    # sweep that silently fell back to serial execution is visible from
+    # the run dir alone. The fold-stack section above stays as-is — this
+    # section covers the generic engine and the degrade accounting.
+    sweeps = [s for s in fits if s.get("name") == "stack_fit"]
+    degrades = [s.get("args", {}) for s in spans
+                if s.get("name") == "stack_degraded"]
+    if sweeps or degrades or counters.get("stack_degrades"):
+        section: Dict[str, Any] = {
+            "n_stacked_fits": len(sweeps),
+            "degrades": len(degrades) or int(
+                counters.get("stack_degrades", 0) or 0),
+            "degrade_reasons": [
+                {"kind": a.get("kind"), "reason": a.get("reason")}
+                for a in degrades],
+        }
+        if sweeps:
+            stops2 = [s.get("args", {}) for s in spans
+                      if s.get("name") == "run_stopped"]
+            last2 = sweeps[-1].get("args", {})
+            # Per-fit fields scope to the LAST stacked fit (a bench-style
+            # run dir holds a warmup stack plus a timed one).
+            section.update(
+                kind=last2.get("kind"),
+                run_count=int(last2.get("run_count", 0)),
+                stack_mesh=last2.get("stack_mesh"),
+                stack_block=last2.get("stack_block"),
+                hyper=last2.get("hyper"),
+                epochs_per_run=last2.get("epochs_run"),
+                best_epochs=last2.get("best_epochs"),
+                early_stops=[{"run": a.get("run"), "epoch": a.get("epoch")}
+                             for a in stops2],
+            )
+        report["stacked_sweep"] = section
     # Serving rollup (scoring service, lfm_quant_tpu/serve/): latency
     # percentiles from the per-request ``latency_ms`` the serve_request
     # spans carry — the SAME numbers ScoringService.stats() and
@@ -309,6 +347,25 @@ def print_report(rep: Dict[str, Any]) -> None:
               f"epochs/fold={fs.get('epochs_per_fold')}  "
               f"best={fs.get('best_epochs')}  "
               f"early_stops={len(fs.get('early_stops') or [])}")
+    sw = rep.get("stacked_sweep")
+    if sw:
+        if sw.get("n_stacked_fits"):
+            extra = (f" (last of {sw['n_stacked_fits']} stacked fits)"
+                     if sw["n_stacked_fits"] > 1 else "")
+            print(f"stacked sweep: {sw.get('kind')} ×{sw.get('run_count')}"
+                  f"{extra}  mesh={sw.get('stack_mesh')}  "
+                  f"block={sw.get('stack_block')}  "
+                  f"operands={sw.get('hyper')}  "
+                  f"epochs/run={sw.get('epochs_per_run')}  "
+                  f"best={sw.get('best_epochs')}  "
+                  f"early_stops={len(sw.get('early_stops') or [])}  "
+                  f"degrades={sw.get('degrades')}")
+        else:
+            reasons = "; ".join(
+                f"{d.get('kind')}: {d.get('reason')}"
+                for d in sw.get("degrade_reasons") or []) or "?"
+            print(f"stacked sweep: DEGRADED to sequential "
+                  f"×{sw.get('degrades')} ({reasons})")
     sv = rep.get("serve")
     if sv:
         p50 = sv.get("p50_ms")

@@ -71,6 +71,40 @@ def test_serve_program_key_no_collisions():
     assert all(k[0] == "serve" for k in keys)
 
 
+@pytest.mark.stacked
+def test_stacked_program_key_families_collision_free():
+    """The three stacked program-key families — foldstack, generic
+    stacked (train/stacked.py), serve — plus the trainer/ensemble keys
+    they wrap cannot collide, whatever their inner components: every
+    family leads with its own tag and every varying field is a tagged
+    tuple component (no positional ambiguity for adversarial geometry
+    to exploit). The stacked key carries operand NAMES, never values —
+    that absence is the engine's compile-once property."""
+    inner = ("trainer", "cpu", ("cpu", 0), 1)
+    keys = [
+        reuse.foldstack_program_key(inner, None, 4, 5),
+        reuse.foldstack_program_key(inner, None, 4, 5, block=2),
+        reuse.foldstack_program_key(inner, None, 5, 4),
+        reuse.stacked_program_key(inner, None, 4, 5, "config", ()),
+        reuse.stacked_program_key(inner, None, 4, 5, "config",
+                                  ("lr", "weight_decay")),
+        reuse.stacked_program_key(inner, None, 4, 5, "config",
+                                  ("lr", "weight_decay"), block=2),
+        reuse.stacked_program_key(inner, None, 4, 5, "seed",
+                                  ("lr", "weight_decay")),
+        reuse.stacked_program_key(inner, None, 5, 4, "config",
+                                  ("lr", "weight_decay")),
+        reuse.serve_program_key(inner, (4, 5)),
+        reuse.serve_program_key(inner, (5, 4)),
+        reuse.ensemble_program_key(inner, None, 4, 5),
+    ]
+    assert len(set(keys)) == len(keys), keys
+    # Distinct families stay distinct even with identical geometry
+    # numbers — the leading tag is the separator.
+    tags = {k[0] for k in keys}
+    assert {"foldstack", "stacked", "serve", "ensemble"} <= tags
+
+
 def test_serve_knob_defaults(monkeypatch):
     for var in ("LFM_SERVE_MAX_ROWS", "LFM_SERVE_MAX_WAIT_MS",
                 "LFM_SERVE_ZOO"):

@@ -1,0 +1,351 @@
+"""Universal stacked-run engine (train/stacked.py): config-sweep parity,
+per-run-operand hyperparameters, run-axis microbatching, and the
+degrade-to-sequential accounting. (The program-key family-separation
+test lives with the other key-collision suites in tests/test_buckets.py,
+carrying this lane's marker.)
+
+The engine's contract is the foldstack lane's, one level up: stacking
+reorders WORK, never results. A stacked LR × weight-decay config sweep
+must reproduce each config's sequential run — epoch histories, best
+epochs, early-stop epochs, restored best params — BIT-identically on the
+unsharded (``LFM_STACK_SHARDS=0``) stack across the LFM_ASYNC knob
+matrix, with exactly ONE counted host sync per stacked epoch and (warm)
+zero jit traces / zero panel H2D. The fold-mesh stack gets the same
+last-ulp reduction-order tolerance policy as every sharded path, with
+decisions still exact.
+
+All tests carry the ``stacked`` marker — the fast CI guard
+(``pytest -m stacked``) against a refactor that quietly breaks the
+stacked/sequential numerical identity or re-bakes a per-run operand
+into a traced constant."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.train.stacked import (
+    HYPER_KEYS,
+    StackUnavailable,
+    parse_sweep_grid,
+    run_config_sweep,
+)
+
+pytestmark = pytest.mark.stacked
+
+#: History fields that must match across execution modes (timing fields
+#: — ts, firm_months_per_sec — legitimately differ). Same policy as the
+#: foldstack lane: val_mse's month-sum reassociates under the run vmap,
+#: so it gets last-ulp tolerance even on the "exact" lane.
+_DET_FIELDS = ("epoch", "train_loss", "grad_norm", "val_ic", "val_mse")
+_ULP_FIELDS = ("val_mse",)
+_GRID = "lr=1e-3,3e-4;weight_decay=1e-4,0"
+
+
+def _cfg(tmp, epochs=3, patience=99, optimizer="adamw"):
+    return RunConfig(
+        name="cswp",
+        data=DataConfig(n_firms=100, n_months=200, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=epochs, warmup_steps=5,
+                          loss="mse", early_stop_patience=patience,
+                          optimizer=optimizer),
+        seed=0,
+        out_dir=str(tmp),
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=100, n_months=200, n_features=5, seed=5)
+
+
+def _sweep(tmp, panel, monkeypatch, *, stacked, name, grid=_GRID,
+           async_on=True, **cfg_kw):
+    monkeypatch.setenv("LFM_ASYNC", "1" if async_on else "0")
+    monkeypatch.setenv("LFM_ASYNC_CKPT", "1" if async_on else "0")
+    out = str(tmp / name)
+    summary = run_config_sweep(_cfg(tmp, **cfg_kw), parse_sweep_grid(grid),
+                               panel=panel, out_dir=out, stacked=stacked)
+    return summary, out
+
+
+def _histories(out_dir, n):
+    return [
+        [json.loads(l) for l in
+         open(os.path.join(out_dir, f"config_{i:03d}", "metrics.jsonl"))]
+        for i in range(n)
+    ]
+
+
+def _assert_parity(seq, stk, exact, check_params=False, panel=None):
+    """Per-config records, histories and (optionally) best params
+    restored from each config dir's ckpt/best line. ``exact`` pins
+    bit-identity; otherwise float fields get last-ulp tolerance while
+    every DECISION (epochs run, best epoch, early-stop epoch) stays
+    exact."""
+    sum_s, d_s = seq
+    sum_k, d_k = stk
+    n = sum_s["n_configs"]
+    assert (sum_k.get("stacked") or {}).get("enabled") is True
+    assert sum_s.get("stacked") is None
+    for rs, rk in zip(sum_s["runs"], sum_k["runs"]):
+        assert rs["epochs_run"] == rk["epochs_run"], rs["config"]
+        assert rs["best_epoch"] == rk["best_epoch"], rs["config"]
+        np.testing.assert_allclose(rk["best_val_ic"], rs["best_val_ic"],
+                                   rtol=0 if exact else 2e-5)
+    assert sum_s["best_index"] == sum_k["best_index"]
+    for i, (a, b) in enumerate(zip(_histories(d_s, n), _histories(d_k, n))):
+        assert [r["epoch"] for r in a] == [r["epoch"] for r in b], i
+        for ra, rb in zip(a, b):
+            for f in _DET_FIELDS:
+                if f not in ra:
+                    continue
+                if exact and f not in _ULP_FIELDS:
+                    assert ra[f] == rb[f], (i, ra["epoch"], f, ra[f], rb[f])
+                else:
+                    np.testing.assert_allclose(
+                        rb[f], ra[f], rtol=1e-6 if exact else 2e-5,
+                        err_msg=f"config {i} {f}")
+    if not check_params:
+        return
+    from lfm_quant_tpu.train.loop import load_trainer
+
+    for i in range(n):
+        ps = jax.tree.leaves(load_trainer(
+            os.path.join(d_s, f"config_{i:03d}"), panel=panel)[0].state.params)
+        pk = jax.tree.leaves(load_trainer(
+            os.path.join(d_k, f"config_{i:03d}"), panel=panel)[0].state.params)
+        for a, b in zip(ps, pk):
+            if exact:
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            else:
+                np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                           atol=5e-6, rtol=1e-4)
+
+
+def test_unsharded_sweep_bit_identical(panel, tmp_path, monkeypatch):
+    """LFM_STACK_SHARDS=0 (pure vmap over the config axis): per-config
+    histories and restored best params are BIT-identical to sequential
+    per-config fits — the per-run-operand optimizer mirror reproduces
+    each config's baked optax chain to the bit, across the LFM_ASYNC
+    knob matrix."""
+    monkeypatch.setenv("LFM_STACK_SHARDS", "0")
+    for async_on in (False, True):
+        tag = "a" if async_on else "s"
+        seq = _sweep(tmp_path, panel, monkeypatch, stacked=False,
+                     async_on=async_on, name=f"seq_{tag}")
+        stk = _sweep(tmp_path, panel, monkeypatch, stacked=True,
+                     async_on=async_on, name=f"stk_{tag}")
+        assert stk[0]["stacked"]["stack_mesh"] is None
+        assert stk[0]["stacked"]["hyper"] == list(HYPER_KEYS)
+        _assert_parity(seq, stk, exact=True, check_params=async_on,
+                       panel=panel)
+
+
+def test_lamb_unsharded_sweep_bit_identical(panel, tmp_path, monkeypatch):
+    """The lamb branch of the per-run-operand mirror (scale_by_adam with
+    eps=1e-6 + trust ratio + the 4-element chain-state reindex) holds
+    the same bit-identity contract as adamw — a chain-order or
+    state-index mistake there would otherwise ship with no failing
+    lane."""
+    monkeypatch.setenv("LFM_STACK_SHARDS", "0")
+    kw = dict(epochs=2, optimizer="lamb", grid="lr=1e-3,3e-4")
+    seq = _sweep(tmp_path, panel, monkeypatch, stacked=False,
+                 name="lamb_seq", **kw)
+    stk = _sweep(tmp_path, panel, monkeypatch, stacked=True,
+                 name="lamb_stk", **kw)
+    _assert_parity(seq, stk, exact=True, check_params=True, panel=panel)
+
+
+def test_divergent_early_stop_parity(panel, tmp_path, monkeypatch):
+    """Configs stopping at DIFFERENT epochs (patience=1, a 30× LR
+    spread): per-config early-stop and best epochs must match the
+    sequential fits exactly — the masked device-side control reproduces
+    each config's FitHarness decisions while its neighbors keep
+    training."""
+    monkeypatch.setenv("LFM_STACK_SHARDS", "0")
+    kw = dict(epochs=8, patience=1, grid="lr=1e-3,1e-4,3e-5")
+    seq = _sweep(tmp_path, panel, monkeypatch, stacked=False,
+                 name="es_seq", **kw)
+    stk = _sweep(tmp_path, panel, monkeypatch, stacked=True,
+                 name="es_stk", **kw)
+    epochs_seq = [r["epochs_run"] for r in seq[0]["runs"]]
+    assert epochs_seq == [r["epochs_run"] for r in stk[0]["runs"]]
+    assert min(epochs_seq) < 8, "at least one config must early-stop"
+    assert len(set(epochs_seq)) > 1, \
+        "config stop epochs must diverge for this test to bite"
+    _assert_parity(seq, stk, exact=True)
+
+
+def test_stack_mesh_decisions_exact(panel, tmp_path, monkeypatch):
+    """Default shards (the stack axis actually sharded on the 8-device
+    CPU platform): histories within last-ulp reduction-order tolerance,
+    every early-stop/best decision exact — the same policy as every
+    sharded path in this repo."""
+    seq = _sweep(tmp_path, panel, monkeypatch, stacked=False, name="m_seq")
+    stk = _sweep(tmp_path, panel, monkeypatch, stacked=True, name="m_stk")
+    if jax.device_count() > 1:
+        assert dict(stk[0]["stacked"]["stack_mesh"])["stack"] > 1
+    _assert_parity(seq, stk, exact=False)
+
+
+def test_stack_block_bit_identical(panel, tmp_path, monkeypatch):
+    """LFM_STACK_BLOCK=2 (run-axis microbatching, the seed_block move
+    one axis up): blocking the 4-run stack into 2-run scan blocks is a
+    pure re-batching — bit-identical to the unblocked stack."""
+    monkeypatch.setenv("LFM_STACK_SHARDS", "0")
+    blocked = {}
+    for blk in ("0", "2"):
+        monkeypatch.setenv("LFM_STACK_BLOCK", blk)
+        summary, out = _sweep(tmp_path, panel, monkeypatch, stacked=True,
+                              name=f"blk_{blk}")
+        assert summary["stacked"]["stack_block"] == int(blk)
+        blocked[blk] = _histories(out, summary["n_configs"])
+    for i, (a, b) in enumerate(zip(blocked["0"], blocked["2"])):
+        for ra, rb in zip(a, b):
+            for f in ("train_loss", "grad_norm", "val_ic"):
+                assert ra[f] == rb[f], (i, ra["epoch"], f)
+
+
+def test_non_dividing_stack_block_degrades_unblocked(panel, tmp_path,
+                                                     monkeypatch):
+    """A block that does not divide the per-shard run count must warn
+    and run unblocked — never truncate or crash the stack."""
+    monkeypatch.setenv("LFM_STACK_SHARDS", "0")
+    monkeypatch.setenv("LFM_STACK_BLOCK", "3")
+    with pytest.warns(UserWarning, match="does not divide"):
+        summary, _ = _sweep(tmp_path, panel, monkeypatch, stacked=True,
+                            name="blk_bad")
+    assert summary["stacked"]["stack_block"] == 0
+
+
+@pytest.mark.reuse
+def test_warm_sweep_zero_traces_zero_transfers(panel, tmp_path,
+                                               monkeypatch):
+    """The reuse lane's contract for config sweeps: a SECOND stacked
+    sweep binds the first one's stacked executables and resident panel —
+    zero new jit traces, zero panel H2D (200 configs, one compiled
+    program: the tentpole's whole point) — and the stacked fit pays
+    exactly ONE counted blocking host sync per stacked epoch (the PR 3
+    pipeline contract through the stacked driver)."""
+    from lfm_quant_tpu.data.windows import clear_panel_cache
+    from lfm_quant_tpu.train import reuse
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    try:
+        _sweep(tmp_path, panel, monkeypatch, stacked=True, name="warmup")
+        snap = REUSE_COUNTERS.snapshot()
+        summary, _ = _sweep(tmp_path, panel, monkeypatch, stacked=True,
+                            name="warm")
+        d = REUSE_COUNTERS.delta(snap)
+        assert d["jit_traces"] == 0, d
+        assert d["panel_transfers"] == 0, d
+        stack = summary["stacked"]
+        epochs = max(r["epochs_run"] for r in summary["runs"])
+        assert stack["reuse"]["host_syncs"] == epochs, stack["reuse"]
+    finally:
+        reuse.clear_program_cache()
+        clear_panel_cache()
+
+
+def test_degrade_to_sequential_is_loud(panel, tmp_path, monkeypatch):
+    """A grid whose configs differ beyond the per-run-operand axes
+    (here: epochs) cannot stack — the sweep must warn, bump the
+    ``stack_degrades`` counter, land a ``stack_degraded`` telemetry
+    instant, and still produce the sequential results."""
+    import dataclasses
+
+    from lfm_quant_tpu.utils import telemetry
+
+    cfg = _cfg(tmp_path, epochs=2)
+    grid = [{"lr": 1e-3}, {"lr": 3e-4}]
+    run_cfgs_bad = [
+        dataclasses.replace(cfg, optim=dataclasses.replace(
+            cfg.optim, lr=g["lr"], epochs=2 + i))
+        for i, g in enumerate(grid)
+    ]
+    from lfm_quant_tpu.data.panel import PanelSplits
+    from lfm_quant_tpu.train.stacked import StackedRuns
+
+    dates = panel.dates
+    splits = PanelSplits.by_date(panel, int(dates[int(len(dates) * 0.7)]),
+                                 int(dates[int(len(dates) * 0.85)]))
+    with pytest.raises(StackUnavailable, match="beyond the per-run axes"):
+        StackedRuns(run_cfgs_bad, [splits, splits], panel, kind="config")
+
+    # The driver-level degrade: monkeypatch the engine to refuse, then
+    # check warning + counter + sequential results all land.
+    before = telemetry.COUNTERS.get("stack_degrades")
+    import lfm_quant_tpu.train.stacked as stacked_mod
+
+    def refuse(*a, **kw):
+        raise StackUnavailable("forced for the degrade test")
+
+    monkeypatch.setattr(stacked_mod, "StackedRuns", refuse)
+    with pytest.warns(UserWarning, match="stacked config sweep "
+                                         "unavailable"):
+        summary = run_config_sweep(cfg, grid, panel=panel,
+                                   out_dir=str(tmp_path / "deg"),
+                                   stacked=True)
+    assert summary["stacked"] is None
+    assert len(summary["runs"]) == 2
+    assert telemetry.COUNTERS.get("stack_degrades") == before + 1
+
+
+def test_foldstack_degrade_bumps_counter(panel, tmp_path):
+    """The fold adapter's degrade path (no rolling window → sequential
+    walk-forward) now shares the loud-degrade accounting: warning AND
+    counter, so trace_report can surface it from a run dir alone."""
+    from lfm_quant_tpu.train.walkforward import run_walkforward
+    from lfm_quant_tpu.utils import telemetry
+
+    before = telemetry.COUNTERS.get("stack_degrades")
+    with pytest.warns(UserWarning, match="fold-stacking unavailable"):
+        run_walkforward(_cfg(tmp_path, epochs=2), panel,
+                        out_dir=str(tmp_path / "fsdeg"), foldstack=True,
+                        start=198001, step_months=12, val_months=24,
+                        n_folds=2)
+    assert telemetry.COUNTERS.get("stack_degrades") == before + 1
+
+
+def test_parse_sweep_grid():
+    """CLI grid spec → cartesian product; unknown axes fail loudly at
+    parse time (a typo'd axis must die before any device work)."""
+    grid = parse_sweep_grid("lr=1e-3,5e-4;weight_decay=1e-4,0")
+    assert len(grid) == 4
+    assert grid[0] == {"lr": 1e-3, "weight_decay": 1e-4}
+    assert grid[-1] == {"lr": 5e-4, "weight_decay": 0.0}
+    assert parse_sweep_grid("lr=1e-3") == [{"lr": 1e-3}]
+    for bad in ("dropout=0.1", "lr", "", "lr=;", "lr=1e-3;lr=1e-4"):
+        with pytest.raises(ValueError):
+            parse_sweep_grid(bad)
+
+
+def test_sweep_summary_ranks_and_dirs_load(panel, tmp_path, monkeypatch):
+    """sweep_summary.json ranks the grid (best_index/best_config agree
+    with the per-run records) and every config dir is a standalone
+    loadable run dir — config.json pins the swept hyperparameters, so
+    ``load_trainer`` rebuilds the exact per-config trainer."""
+    summary, out = _sweep(tmp_path, panel, monkeypatch, stacked=True,
+                          name="rank")
+    on_disk = json.load(open(os.path.join(out, "sweep_summary.json")))
+    assert on_disk["best_index"] == summary["best_index"]
+    best = max(summary["runs"], key=lambda r: r["best_val_ic"])
+    assert summary["best_config"] == best["config"]
+    i = summary["best_index"]
+    cfg_json = json.load(open(os.path.join(
+        out, f"config_{i:03d}", "config.json")))
+    assert cfg_json["optim"]["lr"] == summary["best_config"]["lr"]
+    from lfm_quant_tpu.train.loop import load_trainer
+
+    trainer, _ = load_trainer(os.path.join(out, f"config_{i:03d}"),
+                              panel=panel)
+    assert trainer.cfg.optim.lr == summary["best_config"]["lr"]

@@ -70,6 +70,18 @@ def main(argv=None) -> int:
                          "instead of sequential fits — per-fold results "
                          "match sequential execution; LFM_FOLDSTACK=1 is "
                          "the env equivalent")
+    ap.add_argument("--sweep-grid", metavar="SPEC", default=None,
+                    help="hyperparameter config sweep: semicolon-"
+                         "separated axes of comma-separated values "
+                         "(e.g. 'lr=1e-3,5e-4;weight_decay=1e-4,0'), "
+                         "cartesian-expanded and trained as ONE stacked "
+                         "compiled program (train/stacked.py) with "
+                         "per-config LR/weight-decay threaded as vmapped "
+                         "per-run operands — zero per-config traces. "
+                         "LFM_SWEEP_STACKED=0 forces the sequential "
+                         "per-config reference; per-config run dirs + "
+                         "sweep_summary.json land under "
+                         "<out>/<name>/sweep")
     ap.add_argument("--wf-score", metavar="MODES", default=None,
                     help="grade the stitched out-of-sample panel at the "
                          "end of the sweep: comma-separated aggregation "
@@ -95,6 +107,24 @@ def main(argv=None) -> int:
         ap.error("--wf-foldstack is incompatible with --wf-warm-start/"
                  "--resume (the stacked fit checkpoints folds only at "
                  "finalize; the warm-start carry is serial)")
+    sweep_grid = None
+    if args.sweep_grid is not None:
+        if args.walk_forward is not None:
+            ap.error("--sweep-grid and --walk-forward are separate "
+                     "workloads (compose fold × config grids via "
+                     "train/stacked.py StackedRuns directly)")
+        if args.resume:
+            ap.error("--sweep-grid is incompatible with --resume (the "
+                     "stacked sweep writes config checkpoints only at "
+                     "finalize — nothing per-epoch to resume from)")
+        # Validate at parse time, not after hours of panel/device setup:
+        # a typo'd axis must fail before any backend is touched.
+        from lfm_quant_tpu.train.stacked import parse_sweep_grid
+
+        try:
+            sweep_grid = parse_sweep_grid(args.sweep_grid)
+        except ValueError as e:
+            ap.error(f"--sweep-grid: {e}")
     wf_score_modes = None
     if args.wf_score:
         # Validate HERE, not at end-of-sweep: a typo'd mode must fail at
@@ -165,6 +195,8 @@ def main(argv=None) -> int:
     # LFM_TELEMETRY=0 makes the scope a no-op.
     if args.walk_forward is not None:
         run_dir = os.path.join(cfg.out_dir, cfg.name, "wf")
+    elif sweep_grid is not None:
+        run_dir = os.path.join(cfg.out_dir, cfg.name, "sweep")
     elif cfg.n_seeds > 1:
         run_dir = os.path.join(cfg.out_dir, cfg.name, "ensemble")
     else:
@@ -194,6 +226,12 @@ def main(argv=None) -> int:
                 score_modes=wf_score_modes,
                 foldstack=True if args.wf_foldstack else None)
             summary["run_dir"] = wf_dir
+        elif sweep_grid is not None:
+            from lfm_quant_tpu.train.stacked import run_config_sweep
+
+            summary = run_config_sweep(cfg, sweep_grid, out_dir=run_dir,
+                                       echo=args.echo)
+            summary["run_dir"] = run_dir
         elif cfg.n_seeds > 1:
             from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
             summary, _, _ = run_ensemble_experiment(
