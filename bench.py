@@ -98,6 +98,16 @@ def persist_row(rec: dict) -> None:
               file=sys.stderr, flush=True)
 
 
+def _median(vals):
+    """Middle-averaging median — the same protocol as
+    measure_with_spread: a nearest-element "median" on an even rep
+    count would just be the luckier rep."""
+    vals = sorted(vals)
+    mid = len(vals) // 2
+    return (vals[mid] if len(vals) % 2
+            else 0.5 * (vals[mid - 1] + vals[mid]))
+
+
 def _backend_name() -> str:
     """The backend a JUST-COMPLETED measurement ran on. Only safe to call
     where a measurement has finished — the backend is initialized and
@@ -668,19 +678,11 @@ def bench_config_sweep() -> None:
         last_stack = sum_stk["stacked"]
     finally:
         shutil.rmtree(root, ignore_errors=True)
-    def med(vals):
-        # Same middle-averaging protocol as measure_with_spread: a
-        # nearest-element "median" on an even rep count would just be
-        # the luckier rep.
-        vals = sorted(vals)
-        mid = len(vals) // 2
-        return (vals[mid] if len(vals) % 2
-                else 0.5 * (vals[mid - 1] + vals[mid]))
 
     # Each mode gets its OWN median — pairing them by rep would let one
     # transient hiccup on the seq side inflate the banked speedup.
-    t_seq = med(p[0] for p in pairs)
-    t_stk = med(p[1] for p in pairs)
+    t_seq = _median(p[0] for p in pairs)
+    t_stk = _median(p[1] for p in pairs)
     rates = sorted(3600.0 * R / max(p[1], 1e-9) for p in pairs)
     med_rate = 3600.0 * R / max(t_stk, 1e-9)
     extras = {
@@ -702,6 +704,168 @@ def bench_config_sweep() -> None:
     if rtt is not None:
         extras["rtt_ms"] = rtt
     _emit("config_sweep", med_rate, 0.0, **extras)
+
+
+def bench_bucketed_train() -> None:
+    """bucketed_train — the geometry-bucket metric (LFM_BUCKETS,
+    DESIGN.md §16): epochs/hour with training batches quantized to the
+    (lookback-rows × cross-section-width) ladder vs max-shape padding,
+    on a synthetic MIXED-GEOMETRY panel, plus the padded-FLOP fraction
+    each mode dispatches.
+
+    The panel stitches two regimes: a LARGE universe (wide
+    cross-sections, deep history) over the first ``cut`` months, then a
+    SMALL-CAP SHORT-HISTORY cohort (few firms, all listed at ``cut``)
+    over the rest — so max-shape padding bills every cohort batch at
+    the large universe's width and the full lookback window, which is
+    exactly the tax ROADMAP item 5a describes for international /
+    small-cap / short-history panels. Bucketed mode trains the SAME
+    anchor set (different batch grouping — that is the Khomenko trade),
+    so the ratio prices geometry, not data. A GRU model on purpose: the
+    lookback rung savings scale the serial scan, not just the GEMM
+    width. Parity gate: the bucketed PREDICT of the max-shape-trained
+    params must be BIT-identical to the max-shape sweep before any row
+    is recorded (the speedup must not come from computing something
+    else). Median-of-3 per BASELINE.md; CPU fallback when the tunnel is
+    wedged (the metric prices padding structure, not chips).
+    """
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.data.panel import PanelSplits
+    from lfm_quant_tpu.train import reuse
+    from lfm_quant_tpu.train.loop import Trainer
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+    from lfm_quant_tpu.utils.telemetry import COUNTERS
+
+    n_epochs = int(os.environ.get("LFM_BENCH_BUCKET_EPOCHS", "3"))
+    n_big = int(os.environ.get("LFM_BENCH_BUCKET_BIG", "96"))
+    n_small = int(os.environ.get("LFM_BENCH_BUCKET_SMALL", "24"))
+    n_months, cut, window = 120, 84, 24
+
+    base = synthetic_panel(n_firms=n_big + n_small, n_months=n_months,
+                           n_features=5, seed=7, min_history=24)
+    valid = base.valid.copy()
+    valid[:n_big, cut:] = False    # large universe delists at the cut
+    valid[n_big:, :cut] = False    # small-cap cohort lists AT the cut
+    tv = base.target_valid & valid
+    h = base.horizon
+    tv[:, :-h] &= valid[:, h:]     # target month must still be listed
+    rv = base.ret_valid
+    if rv is not None:
+        rv = rv & valid
+        rv[:, :-1] &= valid[:, 1:]
+    panel = _dc.replace(base, valid=valid, target_valid=tv, ret_valid=rv)
+
+    cfg = RunConfig(
+        name="bucketed_train_bench",
+        data=DataConfig(n_firms=n_big + n_small, n_months=n_months,
+                        n_features=5, window=window, dates_per_batch=4,
+                        firms_per_date=n_big, min_valid_months=8),
+        model=ModelConfig(kind="gru", kwargs={"hidden": 16}),
+        optim=OptimConfig(lr=1e-3, epochs=n_epochs, warmup_steps=5,
+                          early_stop_patience=n_epochs + 1, loss="mse"),
+        seed=0,
+    )
+    splits = PanelSplits.by_date(panel, int(panel.dates[70]),
+                                 int(panel.dates[94]))
+
+    prev = os.environ.get("LFM_BUCKETS")
+
+    def one(bucketed: bool):
+        os.environ["LFM_BUCKETS"] = "1" if bucketed else "0"
+        try:
+            tr = Trainer(cfg, splits)
+            t0 = time.perf_counter()
+            tr.fit()
+            return time.perf_counter() - t0, tr
+        finally:
+            if prev is None:
+                os.environ.pop("LFM_BUCKETS", None)
+            else:
+                os.environ["LFM_BUCKETS"] = prev
+
+    try:
+        # Warmup passes compile both modes' programs through the shared
+        # reuse caches; the timed passes then price the loop, not XLA.
+        _, tr_max = one(False)
+        snap = REUSE_COUNTERS.snapshot()
+        cnt0 = {k: COUNTERS.get(k) for k in
+                ("bucket_cells_dispatched", "bucket_cells_real",
+                 "bucket_cells_max_shape")}
+        _, tr_bkt = one(True)
+        if REUSE_COUNTERS.delta(snap).get("panel_transfers"):
+            raise RuntimeError(
+                "bucketed warmup re-transferred the panel — the "
+                "residency-cache contract broke; row not recorded")
+        cnt = {k: COUNTERS.get(k) - cnt0[k] for k in cnt0}
+        # Parity gate: same params, bucketed vs max-shape inference.
+        tr_bkt.state = tr_max.state
+        os.environ["LFM_BUCKETS"] = "1"
+        try:
+            pred_b, valid_b = tr_bkt.predict()
+        finally:
+            if prev is None:
+                os.environ.pop("LFM_BUCKETS", None)
+            else:
+                os.environ["LFM_BUCKETS"] = prev
+        pred_m, valid_m = tr_max.predict()
+        if not (np.array_equal(pred_b, pred_m)
+                and np.array_equal(valid_b, valid_m)):
+            raise RuntimeError(
+                "bucketed predict diverged from the max-shape sweep — "
+                "parity broken, row not recorded")
+        rtt = dispatch_rtt_ms()
+        reps = max(1, int(os.environ.get("LFM_BENCH_OUTER_REPS", "3")))
+        pairs = []
+        for _ in range(reps):
+            t_max, _ = one(False)
+            t_bkt, _ = one(True)
+            pairs.append((t_max, t_bkt))
+    finally:
+        reuse.clear_program_cache()
+
+    t_max = _median(p[0] for p in pairs)
+    t_bkt = _median(p[1] for p in pairs)
+    rates = sorted(3600.0 * n_epochs / max(p[1], 1e-9) for p in pairs)
+    med_rate = 3600.0 * n_epochs / max(t_bkt, 1e-9)
+    # Padded-FLOP fractions: bucketed from the per-epoch counters; the
+    # max-shape twin from one host-side stacked epoch (weights are
+    # deterministic in (seed, epoch)).
+    disp_b, real_b = cnt["bucket_cells_dispatched"], cnt["bucket_cells_real"]
+    b0 = tr_max.train_sampler.stacked_epoch(0)
+    k, d, bf = b0.firm_idx.shape
+    disp_m = k * d * bf * window
+    real_m = float(b0.weight.sum()) * window
+    extras = {
+        "unit": "epochs/hour",
+        "n_epochs": n_epochs,
+        "max_shape_epochs_per_hour": round(
+            3600.0 * n_epochs / max(t_max, 1e-9), 1),
+        "speedup": round(t_max / max(t_bkt, 1e-9), 3),
+        "padded_flop_fraction_bucketed": (
+            round(1.0 - real_b / disp_b, 4) if disp_b else None),
+        "padded_flop_fraction_max_shape": round(1.0 - real_m / disp_m, 4),
+        "cells_saved_vs_max_shape": (
+            round(1.0 - disp_b / cnt["bucket_cells_max_shape"], 4)
+            if cnt["bucket_cells_max_shape"] else None),
+        "ladder": tr_bkt.train_sampler.bucket_geometry().summary(
+            cfg.data.dates_per_batch)["ladder"],
+        "max_s": round(t_max, 2),
+        "bucketed_s": round(t_bkt, 2),
+        "n_reps": len(pairs),
+    }
+    if len(rates) >= 2:
+        extras["spread_pct"] = round(
+            100.0 * (rates[-1] - rates[0]) / max(med_rate, 1e-9), 1)
+        extras["rep_values"] = [round(v, 1) for v in rates]
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("bucketed_train", med_rate, 0.0, **extras)
 
 
 def _cpu_metric_fallback(flag: str, budget_s: float) -> bool:
@@ -1429,8 +1593,9 @@ def main() -> int:
             if (os.environ.get("LFM_BENCH_FAKE_WEDGE") != "1"
                     and probe.get("kind") == "tunnel_wedged"):
                 for flag in ("--walkforward-reuse", "--walkforward-foldstack",
-                             "--config-sweep", "--scoring-pipeline",
-                             "--epoch-pipeline", "--serve"):
+                             "--config-sweep", "--bucketed-train",
+                             "--scoring-pipeline", "--epoch-pipeline",
+                             "--serve"):
                     _cpu_metric_fallback(
                         flag,
                         deadline_s - (time.monotonic() - t_start) - 30.0)
@@ -1480,6 +1645,14 @@ def main() -> int:
             print(f"bench_config_sweep failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             _emit_status("bench_error", stage="config_sweep",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
+        try:
+            bench_bucketed_train()
+        except Exception as e:  # noqa: BLE001 — earlier rows must still reach the driver
+            print(f"bench_bucketed_train failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            _emit_status("bench_error", stage="bucketed_train",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
         try:
@@ -1542,6 +1715,9 @@ if __name__ == "__main__":
                                      "walkforward_foldstack"))
     if "--config-sweep" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_config_sweep, "config_sweep"))
+    if "--bucketed-train" in sys.argv[1:]:
+        sys.exit(_single_metric_main(bench_bucketed_train,
+                                     "bucketed_train"))
     if "--scoring-pipeline" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_scoring_pipeline,
                                      "scoring_pipeline"))

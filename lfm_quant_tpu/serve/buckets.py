@@ -25,26 +25,19 @@ correctness (the forward masks them; responses slice them off).
 from __future__ import annotations
 
 import os
-from typing import List, Sequence, Tuple
+from typing import Tuple
 
-#: Smallest cross-section bucket (sublane-tiling floor, matching the
-#: sampler's minimum pad multiple in data/windows.py).
-MIN_WIDTH = 8
-
-
-def next_pow2(n: int, floor: int = 1) -> int:
-    """Smallest power of two >= max(n, floor)."""
-    n = max(int(n), floor)
-    p = 1 << (n - 1).bit_length()
-    return p
-
-
-def bucket_width(n_firms: int) -> int:
-    """Cross-section bucket for a month's eligible pool: next power of
-    two, floored at :data:`MIN_WIDTH`."""
-    if n_firms < 1:
-        raise ValueError(f"bucket_width needs >= 1 firm, got {n_firms}")
-    return next_pow2(n_firms, MIN_WIDTH)
+# The pow2 ladder arithmetic is shared with the training-side geometry
+# buckets (PR 8): lfm_quant_tpu/buckets.py is the single source, this
+# module re-exports the serving half so existing imports keep working
+# and the two paths can never drift.
+from lfm_quant_tpu.buckets import (  # noqa: F401 — re-exports
+    MIN_WIDTH,
+    bucket_width,
+    next_pow2,
+    rows_ladder,
+    width_ladder,
+)
 
 
 def bucket_rows(n_requests: int, max_rows: int) -> int:
@@ -53,22 +46,6 @@ def bucket_rows(n_requests: int, max_rows: int) -> int:
     if n_requests < 1:
         raise ValueError(f"bucket_rows needs >= 1 request, got {n_requests}")
     return min(next_pow2(n_requests), next_pow2(max_rows))
-
-
-def rows_ladder(max_rows: int) -> List[int]:
-    """Every row bucket the batcher can produce: 1, 2, 4, … max bucket."""
-    top = next_pow2(max_rows)
-    out, r = [], 1
-    while r <= top:
-        out.append(r)
-        r <<= 1
-    return out
-
-
-def width_ladder(pool_sizes: Sequence[int]) -> List[int]:
-    """The distinct cross-section buckets a universe's serveable months
-    occupy — what warmup must pre-trace (sorted ascending)."""
-    return sorted({bucket_width(int(n)) for n in pool_sizes if n > 0})
 
 
 def max_rows_default() -> int:

@@ -77,6 +77,10 @@ class EnsemblePrograms:
         self.n_seeds = n_seeds
         self.seed_block = seed_block
         self._n_seq = inner._n_seq
+        # Geometry-bucket twins (LFM_BUCKETS), memoized per bundle —
+        # same pattern as TrainerPrograms._bucket_programs.
+        self._bucket_programs: Dict[Tuple[int, int],
+                                    "EnsembleBucketPrograms"] = {}
 
         # vmap the single-seed impls over the stacked state + index batch
         # (device panel broadcast, in_axes=None); under a mesh, shard_map
@@ -182,6 +186,77 @@ class EnsemblePrograms:
 
         return jax.lax.scan(body, state, (fi, ti, w))
 
+    def bucket_programs(self, inner_key: Tuple,
+                        bucket: Tuple[int, int]) -> "EnsembleBucketPrograms":
+        """The bucket's seed-vmapped program twins through the program
+        cache (``reuse.train_bucket_program_key`` over the ENSEMBLE
+        key, so single-seed and ensemble bucket programs can never
+        collide) — see ``TrainerPrograms.bucket_programs``."""
+        bp = self._bucket_programs.get(bucket)
+        if bp is None:
+            from lfm_quant_tpu.train import reuse
+
+            bp = reuse.get_programs(
+                reuse.train_bucket_program_key(inner_key, bucket),
+                lambda: EnsembleBucketPrograms(self, bucket))
+            self._bucket_programs[bucket] = bp
+        return bp
+
+
+class EnsembleBucketPrograms:
+    """Per-(lookback × width) seed-vmapped twins of the ensemble's
+    multi-step / forward / predict programs (``LFM_BUCKETS``) — the
+    ensemble analog of ``train/loop.py BucketPrograms``: the lookback
+    rung is bound into the gather as a static constant, the width rides
+    on the batch aval, everything else is the parent bundles' shared
+    impls (bit-parity with max-shape padding, per seed). Bucketing is
+    rejected under sequence parallelism upstream, so the step axis here
+    is at most 'data'."""
+
+    def __init__(self, ens: EnsemblePrograms, bucket: Tuple[int, int]):
+        from lfm_quant_tpu.train.reuse import (ledger_jit,
+                                               multi_step_donate_argnums)
+        from lfm_quant_tpu.train.stacked import scan_in_blocks
+
+        inner = ens.inner
+        self.bucket = bucket
+        lookback, width = bucket
+        tag = f"b{lookback}x{width}"
+        donate = multi_step_donate_argnums()
+        step_kw = {"window": lookback}
+        if ens.mesh is not None:
+            step_kw["axis"] = (DATA_AXIS,)
+        vstep = jax.vmap(functools.partial(inner._step_impl, **step_kw),
+                         in_axes=(0, None, 0, 0, 0))
+
+        def multi(state, dev, fi, ti, w):
+            def body(st, batch):
+                f, t, ww = batch
+                return scan_in_blocks(
+                    lambda s_, f_, t_, w_: vstep(s_, dev, f_, t_, w_),
+                    ens.seed_block, (st, f, t, ww))
+
+            return jax.lax.scan(body, state, (fi, ti, w))
+
+        if ens.mesh is None:
+            self._jit_multi_step = ledger_jit(
+                f"ens_multi_step@{tag}", multi, donate_argnums=donate)
+        else:
+            self._jit_multi_step = ledger_jit(
+                f"ens_multi_step@{tag}",
+                ens._shard_mapped(multi, steps_axis=True),
+                donate_argnums=donate)
+        self._jit_forward = ledger_jit(
+            f"ens_forward@{tag}",
+            jax.vmap(functools.partial(inner._forward_impl,
+                                       window=lookback),
+                     in_axes=(0, None, None, None, None)))
+        self._jit_predict = ledger_jit(
+            f"ens_predict@{tag}",
+            jax.vmap(functools.partial(inner._forward_impl,
+                                       scores_only=True, window=lookback),
+                     in_axes=(0, None, None, None, None)))
+
 
 class EnsembleTrainer:
     """Trains ``cfg.n_seeds`` members as one vmapped, seed-sharded
@@ -273,6 +348,11 @@ class EnsembleTrainer:
         self.inner = Trainer(cfg, splits, run_dir=None, mesh=self.mesh)
         self.window = self.inner.window
         self.dev = self.inner.dev
+        # Geometry-bucket mode rides the inner trainer's resolution
+        # (LFM_BUCKETS; rejected under a live seq axis there). The
+        # ensemble's GSPMD eval forward has no month-sharded variant, so
+        # no extra eval gating is needed here.
+        self._bucketed = self.inner._bucketed
 
         d = cfg.data
         self.samplers = [
@@ -391,6 +471,49 @@ class EnsembleTrainer:
         of :meth:`_build_epoch` without the firm-month count."""
         return self._build_epoch(epoch)[0]
 
+    def _build_bucketed_epoch(self, epoch: Optional[int]):
+        """Bucketed twin of :meth:`_build_epoch`: per bucket, a
+        ``[K_b, S, D, w]`` stack from the per-seed samplers. Bucket
+        geometry is eligibility-derived and therefore SEED-INVARIANT, so
+        every member contributes the same bucket structure (asserted) —
+        only the within-bucket shuffles differ, preserving per-member
+        data-order independence."""
+        from lfm_quant_tpu.utils.telemetry import COUNTERS
+
+        with telemetry.span("sample", epoch=epoch):
+            per_seed = [s.bucketed_epoch(epoch) for s in self.samplers]
+            keys = [k for k, _ in per_seed[0]]
+            assert all([k for k, _ in ps] == keys for ps in per_seed), \
+                "per-seed bucket geometry diverged"
+            host = []
+            fm = disp = real = mx = 0.0
+            cap = self.samplers[0].firms_per_date
+            for i, (lb, w) in enumerate(keys):
+                fi = np.stack([ps[i][1].firm_idx for ps in per_seed], axis=1)
+                ti = np.stack([ps[i][1].time_idx for ps in per_seed], axis=1)
+                wt = np.stack([ps[i][1].weight for ps in per_seed], axis=1)
+                sl = float(wt.sum())
+                k, s, dd = fi.shape[:3]
+                fm += sl * lb
+                disp += k * s * dd * w * lb
+                real += sl * lb
+                mx += k * s * dd * cap * self.window
+                host.append(((lb, w), (fi, ti, wt)))
+            COUNTERS.bump("bucket_dispatches", len(host))
+            COUNTERS.bump("bucket_cells_dispatched", int(disp))
+            COUNTERS.bump("bucket_cells_real", int(real))
+            COUNTERS.bump("bucket_cells_max_shape", int(mx))
+        with telemetry.span("h2d", epoch=epoch):
+            parts = []
+            for bucket, (fi, ti, wt) in host:
+                arrays = (jnp.asarray(fi), jnp.asarray(ti), jnp.asarray(wt))
+                if self.mesh is not None:
+                    arrays = shard_batch(self.mesh, arrays,
+                                         with_seed_axis=True,
+                                         steps_axis=True)
+                parts.append((bucket, arrays))
+        return parts, fm
+
     # ---- training ----------------------------------------------------
 
     def evaluate(self, params_stacked) -> Dict[str, Any]:
@@ -447,6 +570,8 @@ class EnsembleTrainer:
                                  self._commit_state)
         harness = FitHarness(self.run_dir, cfg.optim.epochs,
                              cfg.optim.early_stop_patience,
+                             self.samplers[0].bucketed_batches_per_epoch()
+                             if self._bucketed else
                              min(s.batches_per_epoch() for s in self.samplers))
         if resume:
             restored = harness.resume(state._asdict())
@@ -457,20 +582,62 @@ class EnsembleTrainer:
         history = []
 
         # Epoch-invariant val-sweep prep, hoisted off the critical path.
-        vb = self.val_sampler.stacked_cross_sections()
-        vargs = self.inner._batch_args(vb)
-        counts = vb.weight.sum(axis=1)  # [M]
+        if self._bucketed:
+            # Bucketed val sweep + bucketed epoch supply (LFM_BUCKETS):
+            # per-bucket dispatches on one stream; per-month per-seed ICs
+            # scatter back to the stacked month order so ``finish``
+            # aggregates exactly what the max-shape sweep produces.
+            vparts = self.val_sampler.bucketed_cross_sections()
+            n_val = sum(pos.size for _, _, pos in vparts)
+            counts = np.zeros(n_val, np.float32)
+            vhoist = []
+            for bucket, b, pos in vparts:
+                counts[pos] = b.weight.sum(axis=1)
+                bp = self.programs.bucket_programs(self.program_key, bucket)
+                vhoist.append((bp, self.inner._batch_args(b),
+                               jnp.asarray(pos)))
+            geo = self.samplers[0].bucket_geometry()
+            bprogs = {bucket: self.programs.bucket_programs(
+                          self.program_key, bucket)
+                      for bucket in geo.train_buckets}
+            telemetry.instant(
+                "bucket_geometry", cat="bucket", n_seeds=self.n_seeds,
+                steps_per_epoch=harness.steps_per_epoch,
+                **geo.summary(cfg.data.dates_per_batch))
+            k_total = float(max(1, harness.steps_per_epoch) * self.n_seeds)
 
-        def build(epoch):
-            return self._build_epoch(epoch)
+            def build(epoch):
+                return self._build_bucketed_epoch(epoch)
 
-        def dispatch(state, arrays):
-            # Whole epoch × all seeds + the vmapped val sweep chained on
-            # one stream; scalars fetched by the driver in one call.
-            state, ms = self._jit_multi_step(state, self.dev, *arrays)
-            _, ic, _ = self._jit_forward(state.params, self.dev, *vargs)
-            return state, {"loss": ms["loss"].mean(), "ic": ic,
-                           "step": state.step[0]}
+            def dispatch(state, parts):
+                loss = jnp.zeros((), jnp.float32)
+                for bucket, arrays in parts:
+                    state, ms = bprogs[bucket]._jit_multi_step(
+                        state, self.dev, *arrays)
+                    loss = loss + ms["loss"].astype(jnp.float32).sum()
+                ic = jnp.zeros((self.n_seeds, n_val), jnp.float32)
+                for bp, va, pos in vhoist:
+                    _, ic_b, _ = bp._jit_forward(state.params, self.dev,
+                                                 *va)
+                    ic = ic.at[:, pos].set(ic_b.astype(jnp.float32))
+                return state, {"loss": loss / k_total, "ic": ic,
+                               "step": state.step[0]}
+        else:
+            vb = self.val_sampler.stacked_cross_sections()
+            vargs = self.inner._batch_args(vb)
+            counts = vb.weight.sum(axis=1)  # [M]
+
+            def build(epoch):
+                return self._build_epoch(epoch)
+
+            def dispatch(state, arrays):
+                # Whole epoch × all seeds + the vmapped val sweep chained
+                # on one stream; scalars fetched by the driver in one
+                # call.
+                state, ms = self._jit_multi_step(state, self.dev, *arrays)
+                _, ic, _ = self._jit_forward(state.params, self.dev, *vargs)
+                return state, {"loss": ms["loss"].mean(), "ic": ic,
+                               "step": state.step[0]}
 
         def finish(epoch, host, fm):
             per_seed = (host["ic"] * counts).sum(axis=1) / counts.sum()
@@ -534,6 +701,24 @@ class EnsembleTrainer:
         )
         out = np.zeros((self.n_seeds, panel.n_firms, panel.n_months), np.float32)
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
+        if self._bucketed and not return_variance:
+            # Bucketed batch scoring (LFM_BUCKETS): per-bucket vmapped
+            # forecast-only dispatches, scattered straight into the
+            # panel — bit-identical to the max-shape sweep for the same
+            # stacked params (see Trainer.predict's bucketed path).
+            for bucket, b, _pos in sampler.bucketed_cross_sections():
+                bp = self.programs.bucket_programs(self.program_key, bucket)
+                fi, ti, w = self.inner._batch_args(b)
+                pred, _, _ = bp._jit_predict(self.state.params, self.dev,
+                                             fi, ti, w)
+                pred = np.asarray(pred)  # [S, M_b, w]
+                real = b.weight > 0
+                rows = b.firm_idx[real]
+                cols = np.broadcast_to(b.time_idx[:, None],
+                                       b.firm_idx.shape)[real]
+                out[:, rows, cols] = pred[:, real]
+                out_valid[rows, cols] = True
+            return out, out_valid
         b = sampler.stacked_cross_sections()
         fi, ti, w = self.inner._batch_args(b)
         if return_variance:

@@ -357,6 +357,11 @@ class TrainerPrograms:
         self._fp = fp
         self.loss_fn = make_loss_fn(cfg.optim.loss)
         self.loss_parts = make_loss_parts(cfg.optim.loss)
+        # Geometry-bucket program twins, memoized per bundle (the zoo's
+        # per-entry memo pattern): a busy program cache may evict the
+        # trainbucket keys, but a trainer already bound to this bundle
+        # keeps its warm bucket executables.
+        self._bucket_programs: Dict[Tuple[int, int], "BucketPrograms"] = {}
         # Stochastic-regularization flag: when dropout is configured, the
         # train step threads a per-step rng + deterministic=False through
         # model.apply (eval stays deterministic). Without it the rng plumb
@@ -554,13 +559,21 @@ class TrainerPrograms:
 
     def _grads_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
                     weight,
-                    axis: Optional[Union[str, Tuple[str, ...]]] = None):
+                    axis: Optional[Union[str, Tuple[str, ...]]] = None,
+                    window: Optional[int] = None):
         """Loss + psum'd gradients of one batch — the optimizer-free
         half of :meth:`_step_impl`, shared with the stacked engine's
         per-run-operand hyper step (train/stacked.py): a config sweep
         computes gradients through exactly this code and applies them
         with per-run (lr, weight-decay) OPERANDS instead of the baked
-        ``self.tx`` chain, so the two paths cannot drift."""
+        ``self.tx`` chain, so the two paths cannot drift.
+
+        ``window`` overrides the gather's lookback length — the
+        geometry-bucket programs (:class:`BucketPrograms`) bind their
+        rung here so a short-history cohort scans W_b steps instead of
+        the full window; None keeps the configured window. Bucketing is
+        rejected under sequence parallelism upstream, so the seq-shard
+        sub-window arithmetic below never sees an override."""
         step_rng = None
         if self._needs_rng:
             # Derived, never stored: resume replays the same stream; the
@@ -588,7 +601,8 @@ class TrainerPrograms:
                 x, m = self._gather(dev["xm"], firm_idx, time_idx - shift,
                                     window=wl)
             else:
-                x, m = self._gather(dev["xm"], firm_idx, time_idx)
+                x, m = self._gather(dev["xm"], firm_idx, time_idx,
+                                    window=window)
             y = gather_targets(dev["targets"], firm_idx, time_idx)
             out = self._apply(params, x, m, rng=step_rng)
             num, den = self.loss_parts(out, y, weight)
@@ -604,13 +618,16 @@ class TrainerPrograms:
 
     def _step_impl(self, state: TrainState, dev: dict, firm_idx, time_idx,
                    weight,
-                   axis: Optional[Union[str, Tuple[str, ...]]] = None):
+                   axis: Optional[Union[str, Tuple[str, ...]]] = None,
+                   window: Optional[int] = None):
         """One train step. ``axis`` names the mesh axis this step runs
         under inside shard_map (None = un-partitioned): the loss is a
         ratio of data-sums, so the global value needs one psum per part,
-        and gradients psum across shards (replicated params)."""
+        and gradients psum across shards (replicated params).
+        ``window``: the geometry-bucket lookback override (see
+        :meth:`_grads_impl`)."""
         loss, grads = self._grads_impl(state, dev, firm_idx, time_idx,
-                                       weight, axis=axis)
+                                       weight, axis=axis, window=window)
         updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
@@ -619,13 +636,15 @@ class TrainerPrograms:
         }
 
     def _multi_step_impl(self, state: TrainState, dev: dict, fi, ti, w,
-                         axis: Optional[Union[str, Tuple[str, ...]]] = None):
+                         axis: Optional[Union[str, Tuple[str, ...]]] = None,
+                         window: Optional[int] = None):
         """K training steps in ONE compiled dispatch: lax.scan over a
         [K, D, Bf] index stack. Per-step dispatch latency (25–30 ms on a
         tunneled device) would otherwise dwarf the ~ms of real compute per
         step; scanning an epoch inside jit removes it entirely."""
         def body(st, batch):
-            return self._step_impl(st, dev, *batch, axis=axis)
+            return self._step_impl(st, dev, *batch, axis=axis,
+                                   window=window)
 
         return jax.lax.scan(body, state, (fi, ti, w))
 
@@ -670,7 +689,8 @@ class TrainerPrograms:
 
     def _forward_impl(self, params, dev: dict, firm_idx, time_idx, weight,
                       rng=None, variance: bool = False, axis=None,
-                      scores_only: bool = False):
+                      scores_only: bool = False,
+                      window: Optional[int] = None):
         """Eval forward: returns (pred [D,Bf], per-month IC [D], mse scalar).
 
         Chunked over the date axis with ``lax.map``: eval sweeps stack ALL
@@ -689,7 +709,9 @@ class TrainerPrograms:
         the per-month IC/MSE metrics like the sampling path does —
         prediction sweeps only consume the forecasts, and an S-seed
         ensemble predict would otherwise pay S × M wasted rank sorts in
-        the dispatch.
+        the dispatch. ``window``: the geometry-bucket lookback override
+        (see :meth:`_grads_impl`) — bound by :class:`BucketPrograms`,
+        never passed through the max-shape jitted entry points.
         """
         if variance and rng is not None:
             raise ValueError("variance + MC-dropout sampling not supported")
@@ -712,7 +734,8 @@ class TrainerPrograms:
             x, m = self._gather(dev["xm"], fi, ti,
                                 impl=(self._eval_gather_sharded
                                       if axis is not None
-                                      else self._eval_gather_impl))
+                                      else self._eval_gather_impl),
+                                window=window)
             out = self._apply(params, x, m, model=self.eval_model,
                               rng=key[0] if key else None)
             if variance:
@@ -749,6 +772,80 @@ class TrainerPrograms:
             ws_sum = jax.lax.psum(ws_sum, axis)
         mse = se_sum / jnp.maximum(ws_sum, 1e-12)
         return pred, ic, mse
+
+    def bucket_programs(self, inner_key: Tuple,
+                        bucket: Tuple[int, int]) -> "BucketPrograms":
+        """The bucket's compiled program twins, through the program
+        cache (``reuse.train_bucket_program_key``) — the exact pattern
+        the serving zoo uses for its per-bucket scoring programs:
+        cross-trainer reuse via the tagged key family, plus a
+        per-bundle memo so eviction never forces a warm holder to
+        rebuild. ``inner_key`` is the key THIS bundle was cached under
+        (the caller's ``program_key`` — equal keys mean byte-identical
+        bundles, so memoizing on the bundle is sound)."""
+        bp = self._bucket_programs.get(bucket)
+        if bp is None:
+            from lfm_quant_tpu.train import reuse
+
+            bp = reuse.get_programs(
+                reuse.train_bucket_program_key(inner_key, bucket),
+                lambda: BucketPrograms(self, bucket))
+            self._bucket_programs[bucket] = bp
+        return bp
+
+
+class BucketPrograms:
+    """Per-(lookback × width) jitted twins of a trainer's multi-step /
+    eval-forward / predict programs (``LFM_BUCKETS``, DESIGN.md §16),
+    cached under ``reuse.train_bucket_program_key``.
+
+    The WIDTH half of the bucket never appears here — it arrives as the
+    batch aval (jit's executable cache keys on it), exactly like the
+    serve programs. The LOOKBACK half must be bound: the gather's
+    window length is a static constant inside the traced program, so a
+    W_b-rung scan is a genuinely different program from the full-window
+    one. Everything else — impls, loss, optimizer, mesh wrapping — is
+    the parent bundle's, which is what makes a bucketed batch's outputs
+    BIT-identical to the same batch padded to max shape (masked steps
+    hold RNN state exactly; weight-0 pad columns are exact no-ops in
+    every loss/metric — the ``bucketed`` lane pins both). Holds only
+    the parent bundle reference and jit wrappers — no panel or state
+    (the lightweight-cache-entry invariant)."""
+
+    def __init__(self, inner: TrainerPrograms, bucket: Tuple[int, int]):
+        from lfm_quant_tpu.train.reuse import (ledger_jit,
+                                               multi_step_donate_argnums)
+
+        self.inner = inner
+        self.bucket = bucket
+        lookback, width = bucket
+        tag = f"b{lookback}x{width}"
+        donate = multi_step_donate_argnums()
+
+        def multi(state, dev, fi, ti, w, axis=None):
+            return inner._multi_step_impl(state, dev, fi, ti, w,
+                                          axis=axis, window=lookback)
+
+        if inner.mesh is None:
+            self._jit_multi_step = ledger_jit(
+                f"multi_step@{tag}", multi, donate_argnums=donate)
+        else:
+            self._jit_multi_step = ledger_jit(
+                f"multi_step@{tag}",
+                inner._shard_mapped(multi, steps_axis=True),
+                donate_argnums=donate)
+
+        def fwd(params, dev, fi, ti, w):
+            return inner._forward_impl(params, dev, fi, ti, w,
+                                       window=lookback)
+
+        self._jit_forward = ledger_jit(f"forward@{tag}", fwd)
+
+        def predict(params, dev, fi, ti, w):
+            return inner._forward_impl(params, dev, fi, ti, w,
+                                       scores_only=True, window=lookback)
+
+        self._jit_predict = ledger_jit(f"predict@{tag}", predict)
 
 
 #: rebind() sentinel: "keep the previous run_dir" (explicit None means
@@ -936,10 +1033,36 @@ class Trainer:
         # it must run before the first dispatch compiles.
         reuse.enable_persistent_cache(cfg.compilation_cache_dir)
 
+        # Geometry-bucket mode (LFM_BUCKETS, DESIGN.md §16): batches
+        # quantize to the sampler's (lookback × width) ladder instead of
+        # one static max shape. Rejected under sequence parallelism (the
+        # seq sub-window arithmetic assumes the full configured window).
+        # The knob is NOT a program-cache key: buckets ride their own
+        # tagged key family, and the base bundle stays shared with the
+        # max-shape path (which is what the bit-parity tests dispatch
+        # against).
+        from lfm_quant_tpu.buckets import buckets_enabled
+
+        self._bucketed = buckets_enabled()
+        if self._bucketed and self._n_seq > 1:
+            import warnings
+
+            warnings.warn(
+                "LFM_BUCKETS is unsupported under sequence parallelism "
+                "(per-shard sub-windows assume the full lookback); "
+                "training with max-shape padding", stacklevel=2)
+            self._bucketed = False
+
         # Compiled-program bundle through the cross-fold cache: an equal
         # key binds a previous trainer's jit wrappers (zero re-tracing
         # for same-shape dispatches), a changed key builds fresh ones.
-        steps_per_epoch = self.train_sampler.batches_per_epoch()
+        # Bucketed epochs floor leftover dates per BUCKET, so their step
+        # count (and with it the LR-schedule horizon baked into the
+        # traced update — hence the key) is the bucketed count.
+        steps_per_epoch = (self.train_sampler.bucketed_batches_per_epoch()
+                           if self._bucketed
+                           else self.train_sampler.batches_per_epoch())
+        self._steps_per_epoch = steps_per_epoch
         self.program_key = reuse.trainer_program_key(
             cfg, self.mesh, self._n_seq, self._gather_impl,
             self._eval_gather_impl, self._eval_gather_sharded, self._fp,
@@ -961,6 +1084,12 @@ class Trainer:
         self.model, self.eval_model, self.tx = p.model, p.eval_model, p.tx
         self.loss_fn, self.loss_parts = p.loss_fn, p.loss_parts
         self._eval_sharded = p._eval_sharded
+        # Bucketed eval sweeps stay off the month-sharded path: the
+        # per-bucket month counts would each need padding to the data
+        # axis, eroding exactly the padding the buckets remove. Under a
+        # sharded eval mesh, val/predict keep max-shape geometry while
+        # TRAIN batches still bucket.
+        self._bucketed_eval = self._bucketed and not p._eval_sharded
         self._jit_step = p._jit_step
         self._jit_multi_step = p._jit_multi_step
         self._jit_forward = p._jit_forward
@@ -1148,7 +1277,7 @@ class Trainer:
             state = self._warm_state(state, init_params)
         harness = FitHarness(self.run_dir, cfg.optim.epochs,
                              cfg.optim.early_stop_patience,
-                             self.train_sampler.batches_per_epoch())
+                             self._steps_per_epoch)
         if resume:
             restored = harness.resume(state._asdict())
             if restored is not None:
@@ -1161,48 +1290,136 @@ class Trainer:
         # (and, under a mesh, its padded device placement) is identical
         # every epoch — building it per epoch was pure host overhead on
         # the critical path.
-        vb = self.val_sampler.stacked_cross_sections()
-        counts = vb.weight.sum(axis=1)
-        if self._eval_sharded:
-            vargs = self._eval_batch_args(vb)
-            n_val = vb.weight.shape[0]
+        if self._bucketed_eval:
+            # Bucketed val sweep (LFM_BUCKETS): one hoisted batch + one
+            # compiled forward per (lookback × width) bucket; per-month
+            # ICs scatter back to the stacked month order through the
+            # buckets' position arrays, so ``finish`` aggregates exactly
+            # the values the max-shape sweep would produce (per-month
+            # parity is the bit-identity contract; mse recombines as
+            # Σ se / Σ ws via the host-known per-bucket weights).
+            vparts = self.val_sampler.bucketed_cross_sections()
+            n_val = sum(pos.size for _, _, pos in vparts)
+            counts = np.zeros(n_val, np.float32)
+            vhoist = []
+            for bucket, b, pos in vparts:
+                counts[pos] = b.weight.sum(axis=1)
+                bp = self.programs.bucket_programs(self.program_key, bucket)
+                vhoist.append((bp,
+                               (jnp.asarray(b.firm_idx),
+                                jnp.asarray(b.time_idx),
+                                jnp.asarray(b.weight)),
+                               jnp.asarray(pos), float(b.weight.sum())))
+            w_total = max(sum(h[3] for h in vhoist), 1e-12)
 
             def val_dispatch(params):
-                _, ic, mse = self._jit_fwd_det(params, self.dev, *vargs)
-                return ic[:n_val], mse
-        else:
-            vargs = (jnp.asarray(vb.firm_idx), jnp.asarray(vb.time_idx),
-                     jnp.asarray(vb.weight))
-
-            def val_dispatch(params):
-                _, ic, mse = self._jit_forward(params, self.dev, *vargs)
+                ic = jnp.zeros((n_val,), jnp.float32)
+                mse = jnp.zeros((), jnp.float32)
+                for bp, vargs, pos, wsum in vhoist:
+                    _, ic_b, mse_b = bp._jit_forward(params, self.dev,
+                                                     *vargs)
+                    ic = ic.at[pos].set(ic_b.astype(jnp.float32))
+                    mse = mse + mse_b.astype(jnp.float32) * (wsum / w_total)
                 return ic, mse
+        else:
+            vb = self.val_sampler.stacked_cross_sections()
+            counts = vb.weight.sum(axis=1)
+            if self._eval_sharded:
+                vargs = self._eval_batch_args(vb)
+                n_val = vb.weight.shape[0]
 
-        def build(epoch):
-            # Whole epoch as one [K, D, Bf] index stack; firm-months are
-            # known on the host before any device work. The two spans
-            # split host sampling from H2D staging (they emit on the
-            # prefetch thread under LFM_ASYNC).
-            with telemetry.span("sample", epoch=epoch):
-                b = self.train_sampler.stacked_epoch(epoch)
-                fm = float(b.weight.sum()) * self.window
-            with telemetry.span("h2d", epoch=epoch):
-                args = self._batch_args(b, train=True, steps=True)
-            return args, fm
+                def val_dispatch(params):
+                    _, ic, mse = self._jit_fwd_det(params, self.dev, *vargs)
+                    return ic[:n_val], mse
+            else:
+                vargs = (jnp.asarray(vb.firm_idx), jnp.asarray(vb.time_idx),
+                         jnp.asarray(vb.weight))
 
-        def dispatch(state, args):
-            # Train epoch + chained validation sweep on one stream; no
-            # host round-trip here — the driver fetches ``vals`` in a
-            # single device_get when the epoch settles.
-            state, ms = self._jit_multi_step(state, self.dev, *args)
-            ic, mse = val_dispatch(state.params)
-            # step is COPIED out of the state: the lookahead dispatch
-            # donates every state leaf, and a fetched scalar must not
-            # alias a donated buffer.
-            return state, {"loss": ms["loss"].mean(),
-                           "grad_norm": ms["grad_norm"].mean(),
-                           "ic": ic, "mse": mse,
-                           "step": jnp.copy(state.step)}
+                def val_dispatch(params):
+                    _, ic, mse = self._jit_forward(params, self.dev, *vargs)
+                    return ic, mse
+
+        if self._bucketed:
+            # Bucketed epoch supply: per-bucket [K_b, D, w_b] stacks on
+            # an epoch-invariant ladder, one donating multi-step dispatch
+            # per bucket chained on the same stream (the state is
+            # consumed linearly, so donation holds across the chain).
+            geo = self.train_sampler.bucket_geometry()
+            bprogs = {bucket: self.programs.bucket_programs(
+                          self.program_key, bucket)
+                      for bucket in geo.train_buckets}
+            telemetry.instant(
+                "bucket_geometry", cat="bucket",
+                steps_per_epoch=self._steps_per_epoch,
+                **geo.summary(cfg.data.dates_per_batch))
+            k_total = float(max(1, self._steps_per_epoch))
+
+            def build(epoch):
+                with telemetry.span("sample", epoch=epoch):
+                    parts = self.train_sampler.bucketed_epoch(epoch)
+                    fm = disp = real = mx = 0.0
+                    for (lb, w), b in parts:
+                        sl = float(b.weight.sum())
+                        k, dd = b.firm_idx.shape[:2]
+                        fm += sl * lb
+                        disp += k * dd * w * lb
+                        real += sl * lb
+                        mx += (k * dd * self.train_sampler.firms_per_date
+                               * self.window)
+                    # Padded-FLOP accounting (locked bumps — the build
+                    # runs on the prefetch thread under LFM_ASYNC).
+                    telemetry.COUNTERS.bump("bucket_dispatches",
+                                            len(parts))
+                    telemetry.COUNTERS.bump("bucket_cells_dispatched",
+                                            int(disp))
+                    telemetry.COUNTERS.bump("bucket_cells_real", int(real))
+                    telemetry.COUNTERS.bump("bucket_cells_max_shape",
+                                            int(mx))
+                with telemetry.span("h2d", epoch=epoch):
+                    args = [(bkt, self._batch_args(b, train=True,
+                                                   steps=True))
+                            for bkt, b in parts]
+                return args, fm
+
+            def dispatch(state, parts):
+                loss = jnp.zeros((), jnp.float32)
+                gnorm = jnp.zeros((), jnp.float32)
+                for bucket, args in parts:
+                    state, ms = bprogs[bucket]._jit_multi_step(
+                        state, self.dev, *args)
+                    loss = loss + ms["loss"].astype(jnp.float32).sum()
+                    gnorm = gnorm + ms["grad_norm"].astype(jnp.float32).sum()
+                ic, mse = val_dispatch(state.params)
+                return state, {"loss": loss / k_total,
+                               "grad_norm": gnorm / k_total,
+                               "ic": ic, "mse": mse,
+                               "step": jnp.copy(state.step)}
+        else:
+            def build(epoch):
+                # Whole epoch as one [K, D, Bf] index stack; firm-months
+                # are known on the host before any device work. The two
+                # spans split host sampling from H2D staging (they emit
+                # on the prefetch thread under LFM_ASYNC).
+                with telemetry.span("sample", epoch=epoch):
+                    b = self.train_sampler.stacked_epoch(epoch)
+                    fm = float(b.weight.sum()) * self.window
+                with telemetry.span("h2d", epoch=epoch):
+                    args = self._batch_args(b, train=True, steps=True)
+                return args, fm
+
+            def dispatch(state, args):
+                # Train epoch + chained validation sweep on one stream;
+                # no host round-trip here — the driver fetches ``vals``
+                # in a single device_get when the epoch settles.
+                state, ms = self._jit_multi_step(state, self.dev, *args)
+                ic, mse = val_dispatch(state.params)
+                # step is COPIED out of the state: the lookahead dispatch
+                # donates every state leaf, and a fetched scalar must not
+                # alias a donated buffer.
+                return state, {"loss": ms["loss"].mean(),
+                               "grad_norm": ms["grad_norm"].mean(),
+                               "ic": ic, "mse": mse,
+                               "step": jnp.copy(state.step)}
 
         def finish(epoch, host, fm):
             val_ic = float(np.average(host["ic"], weights=counts))
@@ -1293,6 +1510,28 @@ class Trainer:
             date_range=date_range or self.splits.range_of(split),
             require_target=require_target,
         )
+        if (self._bucketed and not self._eval_sharded and mc_samples == 0
+                and not return_variance):
+            # Bucketed batch scoring (LFM_BUCKETS): one forecast-only
+            # dispatch per (lookback × width) bucket, scattered straight
+            # into the panel — results BIT-identical to the max-shape
+            # sweep for the same params (pure inference; the ``bucketed``
+            # lane pins it), with the thin months' pad columns and the
+            # short-history cohort's dead scan steps compiled out.
+            out = np.zeros((panel.n_firms, panel.n_months), np.float32)
+            out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
+            for bucket, b, _pos in sampler.bucketed_cross_sections():
+                bp = self.programs.bucket_programs(self.program_key, bucket)
+                pred, _, _ = bp._jit_predict(
+                    self.state.params, self.dev, jnp.asarray(b.firm_idx),
+                    jnp.asarray(b.time_idx), jnp.asarray(b.weight))
+                real = b.weight > 0
+                rows = b.firm_idx[real]
+                cols = np.broadcast_to(b.time_idx[:, None],
+                                       b.firm_idx.shape)[real]
+                out[rows, cols] = np.asarray(pred)[real]
+                out_valid[rows, cols] = True
+            return out, out_valid
         out_valid = np.zeros((panel.n_firms, panel.n_months), bool)
         b = sampler.stacked_cross_sections()
         real = b.weight > 0  # [M, bf]

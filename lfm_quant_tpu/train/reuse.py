@@ -224,6 +224,27 @@ def stacked_program_key(inner_key: Tuple, mesh, run_count: int,
             ("hyper", tuple(hyper_keys)), ("block", int(block)))
 
 
+def train_bucket_program_key(inner_key: Tuple,
+                             bucket: Tuple[int, int]) -> Tuple:
+    """Cache key for a TRAINING geometry-bucket program family
+    (``LFM_BUCKETS``, DESIGN.md §16): the inner trainer/ensemble
+    bundle's key plus the ``(lookback_rows, cross_section_width)``
+    bucket the batch supply quantized to (data/windows.py
+    ``bucket_geometry``). Same tagged-tuple construction as
+    :func:`serve_program_key` — the leading ``"trainbucket"`` tag and
+    the tagged bucket component make keys collision-free against the
+    trainer/ensemble/foldstack/stacked/serve families by construction
+    (a serve bucket ``(rows, width)`` with the same numbers is a
+    DIFFERENT key). Deliberately absent: the epoch, the per-bucket
+    step count K_b and the batch contents — those arrive as jit
+    ARGUMENTS, so each bucket compiles exactly once and warm epochs
+    re-dispatch cached executables (the reuse-lane zero-trace
+    contract, per bucket)."""
+    lookback, width = bucket
+    return ("trainbucket", inner_key,
+            ("bucket", int(lookback), int(width)))
+
+
 def serve_program_key(inner_key: Tuple, bucket: Tuple[int, int]) -> Tuple:
     """Cache key for a serving (bucketed scoring) program: the inner
     trainer bundle's key (already backend/mesh/gather/window-qualified —

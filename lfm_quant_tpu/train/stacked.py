@@ -476,6 +476,19 @@ class StackedRuns:
         if len(run_cfgs) < 2:
             raise StackUnavailable(
                 f"run-stacking needs >= 2 runs, got {len(run_cfgs)}")
+        from lfm_quant_tpu.buckets import buckets_enabled
+
+        if buckets_enabled():
+            # The stacked epoch program is one fused fixed-shape
+            # dispatch; per-bucket [R, K_b, D, w_b] stacks would need a
+            # restructured carry. The sequential path the drivers
+            # degrade to IS bucket-capable (Trainer/EnsembleTrainer fit
+            # per run), so the composition stays loud, correct and
+            # compile-once — just not stacked (DESIGN.md §16).
+            raise StackUnavailable(
+                "geometry-bucketed batching (LFM_BUCKETS=1) does not "
+                "compose with the stacked-run engines yet — runs degrade "
+                "to the sequential bucketed path")
         if len(run_splits) != len(run_cfgs):
             raise ValueError("run_cfgs and run_splits length mismatch")
         cfg = run_cfgs[0]
@@ -1068,6 +1081,160 @@ def run_config_sweep(cfg: RunConfig, grid: Sequence[Dict[str, float]],
         "best_index": best_index,
         "best_config": grid[best_index],
         "best_val_ic": runs[best_index]["best_val_ic"],
+    }
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "sweep_summary.json"), "w") as fh:
+            json.dump(summary, fh, indent=2)
+    return summary
+
+
+def run_walkforward_sweep(cfg: RunConfig, grid: Sequence[Dict[str, float]],
+                          panel: Optional[Panel] = None, *, start: int,
+                          step_months: int = 12, val_months: int = 24,
+                          n_folds: Optional[int] = None,
+                          train_months: Optional[int] = None,
+                          out_dir: Optional[str] = None, echo: bool = False,
+                          stacked: Optional[bool] = None) -> Dict[str, Any]:
+    """The fold × config PRODUCT sweep (``train.py --sweep-grid``
+    composed with ``--walk-forward``): every (walk-forward fold,
+    hyperparameter config) pair trained as one run of a single
+    :class:`StackedRuns` stack — each run carries its OWN (cfg, splits)
+    pair, which is exactly the per-run surface the engine already
+    exposes (ROADMAP open item 2: "wiring, not architecture"). Per-config
+    LR/weight-decay ride as vmapped per-run operands; per-fold split
+    boundaries and fold-offset seeds ride as per-run data, so the whole
+    F × C product compiles ONCE.
+
+    The product answers the question a single-split sweep cannot: does
+    the winning config WIN ACROSS REGIMES, or only on one validation
+    window? ``summary["by_config"]`` carries each config's mean/min best
+    val IC over folds; ``summary["folds"]`` each fold's own ranking.
+
+    A rolling ``train_months`` window keeps every fold the same shape
+    (stackable); expanding-window folds usually differ in
+    steps-per-epoch and degrade LOUDLY to sequential per-run fits
+    (warning + ``stack_degraded`` instant + ``stack_degrades`` counter),
+    as does ``LFM_BUCKETS=1`` — the degrade path trains the identical
+    runs, just serially. Run dirs land under
+    ``<out_dir>/fold_<k>/config_<j>`` (loadable like any fold dir);
+    ``sweep_summary.json`` ranks the product. No forecast stitching:
+    stitching wants ONE config per fold — pick the winner here, then run
+    the plain walk-forward with it."""
+    import json
+
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+    from lfm_quant_tpu.train.loop import Trainer, resolve_panel
+    from lfm_quant_tpu.train.walkforward import (month_add,
+                                                 walkforward_folds,
+                                                 write_fold_run_dir)
+
+    grid = [dict(g) for g in grid]
+    if not grid:
+        raise ValueError("empty sweep grid")
+    bad = sorted(set().union(*(set(g) for g in grid)) - set(HYPER_KEYS))
+    if bad:
+        raise ValueError(
+            f"unsupported sweep axes {bad}; per-run operands cover "
+            f"{', '.join(HYPER_KEYS)}")
+    if stacked is None:
+        stacked = sweep_stacked_enabled()
+    if panel is None:
+        panel = resolve_panel(cfg.data)
+    folds = walkforward_folds(panel, start, step_months, val_months,
+                              n_folds)
+    F, C = len(folds), len(grid)
+    ensemble = cfg.n_seeds > 1
+
+    run_cfgs: List[RunConfig] = []
+    run_splits: List[PanelSplits] = []
+    run_dirs: List[Optional[str]] = []
+    for k, (train_end, val_end, _pred) in enumerate(folds):
+        train_start = (month_add(train_end, -train_months)
+                       if train_months else None)
+        splits = PanelSplits.by_date(panel, train_end, val_end,
+                                     train_start=train_start)
+        for j, g in enumerate(grid):
+            rc = dataclasses.replace(
+                cfg, seed=cfg.seed + 1000 * k,
+                optim=dataclasses.replace(cfg.optim, **g))
+            rd = (os.path.join(out_dir, f"fold_{k}", f"config_{j:03d}")
+                  if out_dir else None)
+            if rd:
+                write_fold_run_dir(rc, rd, train_end, val_end,
+                                   train_start, ensemble)
+            run_cfgs.append(rc)
+            run_splits.append(splits)
+            run_dirs.append(rd)
+
+    run_sums = None
+    stack_info = None
+    with telemetry.span("wf_config_sweep", cat="fit", n_folds=F,
+                        n_configs=C):
+        if stacked and F * C >= 2:
+            try:
+                eng = StackedRuns(run_cfgs, run_splits, panel, kind="grid",
+                                  run_dirs=run_dirs, echo=echo)
+                run_sums, stack_info = eng.fit()
+            except StackUnavailable as e:
+                warnings.warn(
+                    f"stacked fold×config sweep unavailable ({e}); "
+                    "running the runs sequentially", stacklevel=2)
+                telemetry.instant("stack_degraded", kind="grid",
+                                  reason=str(e))
+                telemetry.COUNTERS.bump("stack_degrades")
+        if run_sums is None:
+            run_sums = []
+            for rc, sp, rd in zip(run_cfgs, run_splits, run_dirs):
+                trainer = (EnsembleTrainer if ensemble else Trainer)(
+                    rc, sp, run_dir=rd, echo=echo)
+                fit = trainer.fit()
+                run_sums.append({
+                    "best_val_ic": fit["best_val_ic"],
+                    "best_epoch": fit["best_epoch"],
+                    "epochs_run": fit["epochs_run"],
+                })
+
+    fold_recs = []
+    for k, (train_end, val_end, _pred) in enumerate(folds):
+        runs = [{
+            "config": grid[j],
+            "run_dir": run_dirs[k * C + j],
+            "best_val_ic": run_sums[k * C + j]["best_val_ic"],
+            "best_epoch": run_sums[k * C + j]["best_epoch"],
+            "epochs_run": run_sums[k * C + j]["epochs_run"],
+        } for j in range(C)]
+        fold_recs.append({
+            "fold": k,
+            "train_end": train_end,
+            "val_end": val_end,
+            "runs": runs,
+            "best_index": int(max(range(C),
+                                  key=lambda j: runs[j]["best_val_ic"])),
+        })
+    by_config = []
+    for j in range(C):
+        ics = [run_sums[k * C + j]["best_val_ic"] for k in range(F)]
+        by_config.append({
+            "config": grid[j],
+            "mean_best_val_ic": float(np.mean(ics)),
+            "min_best_val_ic": float(np.min(ics)),
+            "per_fold": [float(v) for v in ics],
+        })
+    best_index = int(max(range(C),
+                         key=lambda j: by_config[j]["mean_best_val_ic"]))
+    summary = {
+        "n_folds": F,
+        "n_configs": C,
+        "grid": grid,
+        "step_months": step_months,
+        "val_months": val_months,
+        "train_months": train_months,
+        "folds": fold_recs,
+        "by_config": by_config,
+        "best_index": best_index,
+        "best_config": grid[best_index],
+        "stacked": stack_info,
     }
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)

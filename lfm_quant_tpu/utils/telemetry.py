@@ -455,6 +455,7 @@ _KNOB_PROBES = (
     ("async_pipeline", "lfm_quant_tpu.train.reuse", "async_enabled"),
     ("async_ckpt", "lfm_quant_tpu.train.reuse", "async_ckpt_enabled"),
     ("foldstack", "lfm_quant_tpu.train.reuse", "foldstack_enabled"),
+    ("buckets", "lfm_quant_tpu.buckets", "buckets_enabled"),
     ("jax_backtest", "lfm_quant_tpu.backtest", "jax_backtest_enabled"),
 )
 

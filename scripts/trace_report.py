@@ -251,6 +251,36 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
                              for a in stops2],
             )
         report["stacked_sweep"] = section
+    # Bucketed-geometry rollup (LFM_BUCKETS, DESIGN.md §16): the ladder
+    # and static per-epoch cell budgets from the fits' bucket_geometry
+    # instants, plus the MEASURED padded-FLOP accounting from the
+    # run-level bucket_* counters (bumped per built epoch) — the
+    # occupancy/padding numbers ``bench.py bucketed_train`` prices.
+    geos = [s.get("args", {}) for s in spans
+            if s.get("name") == "bucket_geometry"]
+    if geos or counters.get("bucket_dispatches"):
+        disp = float(counters.get("bucket_cells_dispatched", 0) or 0)
+        real_c = float(counters.get("bucket_cells_real", 0) or 0)
+        mx = float(counters.get("bucket_cells_max_shape", 0) or 0)
+        last_geo = geos[-1] if geos else {}
+        report["buckets"] = {
+            "n_fits": len(geos),
+            "ladder": last_geo.get("ladder"),
+            "n_train_buckets": last_geo.get("n_train_buckets"),
+            "n_eval_buckets": last_geo.get("n_eval_buckets"),
+            "dispatches": int(counters.get("bucket_dispatches", 0) or 0),
+            "cells_dispatched": int(disp),
+            "cells_real": int(real_c),
+            "cells_max_shape": int(mx),
+            # Of the cells actually dispatched, how many were padding —
+            # and how many the ladder saved vs max-shape padding.
+            "padded_flop_fraction": (round(1.0 - real_c / disp, 4)
+                                     if disp else None),
+            "padded_flop_fraction_max_shape": (
+                round(1.0 - real_c / mx, 4) if mx else None),
+            "cells_saved_vs_max_shape": (round(1.0 - disp / mx, 4)
+                                         if mx else None),
+        }
     # Serving rollup (scoring service, lfm_quant_tpu/serve/): latency
     # percentiles from the per-request ``latency_ms`` the serve_request
     # spans carry — the SAME numbers ScoringService.stats() and
@@ -366,6 +396,13 @@ def print_report(rep: Dict[str, Any]) -> None:
                 for d in sw.get("degrade_reasons") or []) or "?"
             print(f"stacked sweep: DEGRADED to sequential "
                   f"×{sw.get('degrades')} ({reasons})")
+    bk = rep.get("buckets")
+    if bk:
+        print(f"buckets     : ladder={bk.get('ladder')}  "
+              f"padded_flop={bk.get('padded_flop_fraction')}"
+              f" (max-shape {bk.get('padded_flop_fraction_max_shape')})  "
+              f"cells_saved={bk.get('cells_saved_vs_max_shape')}  "
+              f"dispatches={bk.get('dispatches')}")
     sv = rep.get("serve")
     if sv:
         p50 = sv.get("p50_ms")

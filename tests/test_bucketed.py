@@ -1,0 +1,416 @@
+"""Geometry-bucket lane (LFM_BUCKETS; data/windows.py bucket ladder,
+train/loop.py BucketPrograms, DESIGN.md §16).
+
+The tentpole contracts, all measured:
+
+* **Bit-parity** — a bucketed batch run through the bucket programs
+  equals the SAME batch padded to max shape run through the max-shape
+  programs, bit for bit: training loss/updated params, eval forecasts
+  and per-month ICs, and the stacked engine's shared gradient path.
+  The mask contract (weight-0 pad columns are exact no-ops; masked RNN
+  steps hold state exactly) is what makes this an equality, not a
+  tolerance.
+* **Compile-once** — a warm same-geometry bucketed fit pays ZERO jit
+  traces and ZERO panel H2D, with ONE host sync per epoch (the reuse
+  contract with bucketing ON); per-bucket programs ride the tagged
+  ``trainbucket`` key family through the shared program cache.
+* **Loud degrade** — the stacked-run engines reject LFM_BUCKETS with
+  ``StackUnavailable`` and the drivers degrade to the (bucket-capable)
+  sequential path with a warning + ``stack_degraded`` instant +
+  ``stack_degrades`` counter, never silently.
+
+Pure-ladder arithmetic and key-family collision tests live in
+tests/test_buckets.py (the device-free early lane).
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.buckets import (
+    bucket_lookback,
+    buckets_enabled,
+    capped_width,
+    lookback_rungs,
+    width_rungs,
+)
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.data.windows import DateBatchSampler, clear_panel_cache
+from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.train.loop import Trainer
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+pytestmark = pytest.mark.bucketed
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches(monkeypatch):
+    """Deterministic counters + the knob OFF unless a test opts in."""
+    monkeypatch.delenv("LFM_BUCKETS", raising=False)
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    yield
+    reuse.clear_program_cache()
+    clear_panel_cache()
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=100, n_months=200, n_features=5, seed=5)
+
+
+def _cfg(tmp, n_seeds=1, epochs=2, kind="mlp", **model_kwargs):
+    kwargs = {"hidden": (16,)} if kind == "mlp" else {"hidden": 8}
+    kwargs.update(model_kwargs)
+    return RunConfig(
+        name="bk",
+        data=DataConfig(n_firms=100, n_months=200, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind=kind, kwargs=kwargs),
+        optim=OptimConfig(lr=1e-3, epochs=epochs, warmup_steps=5,
+                          early_stop_patience=epochs + 1, loss="mse"),
+        seed=0,
+        n_seeds=n_seeds,
+        out_dir=str(tmp),
+    )
+
+
+def _splits(panel):
+    return PanelSplits.by_date(panel, 198001, 198201)
+
+
+# ---- ladder / geometry (host-side) ---------------------------------------
+
+
+def test_knob_default_off(monkeypatch):
+    monkeypatch.delenv("LFM_BUCKETS", raising=False)
+    assert not buckets_enabled()
+    monkeypatch.setenv("LFM_BUCKETS", "1")
+    assert buckets_enabled()
+    monkeypatch.setenv("LFM_BUCKETS", "0")
+    assert not buckets_enabled()
+
+
+def test_ladders_are_finite_and_cover():
+    assert width_rungs(32) == [8, 16, 32]
+    assert width_rungs(77) == [8, 16, 32, 64, 77]  # cap is a member
+    for n in range(1, 200):
+        assert capped_width(n, 77) in width_rungs(77)
+    assert lookback_rungs(60) == [8, 16, 32, 60]
+    assert lookback_rungs(12) == [8, 12]
+    assert lookback_rungs(8) == [8]
+    for d in range(0, 61):
+        assert bucket_lookback(d, 60) in lookback_rungs(60)
+        assert bucket_lookback(d, 60) >= min(d, 60)
+
+
+def test_bucket_geometry_partitions_and_fills(panel):
+    s = DateBatchSampler(panel, 12, 4, 32, seed=0)
+    geo = s.bucket_geometry()
+    # Every training date lands in exactly one bucket; every bucket
+    # fills at least one whole [D]-date batch (the fold rule).
+    all_dates = np.concatenate(list(geo.train_buckets.values()))
+    assert sorted(all_dates.tolist()) == sorted(s._dates.tolist())
+    for (lb, w), dates in geo.train_buckets.items():
+        assert dates.size >= s.dates_per_batch
+        assert lb in lookback_rungs(12) and w in width_rungs(32)
+    # Eval buckets cover every stacked month position exactly once.
+    pos = np.concatenate(list(geo.eval_buckets.values()))
+    assert sorted(pos.tolist()) == list(range(s.stacked_eval_months()))
+    # The summary's cell budgets are consistent.
+    summ = geo.summary(4)
+    assert summ["train_cells_bucketed"] <= summ["train_cells_max_shape"]
+    assert summ["eval_cells_bucketed"] < summ["eval_cells_max_shape"]
+
+
+def test_bucketed_epoch_deterministic_and_shape_stable(panel):
+    s = DateBatchSampler(panel, 12, 4, 32, seed=3)
+    a = s.bucketed_epoch(1)
+    b = s.bucketed_epoch(1)
+    assert [k for k, _ in a] == [k for k, _ in b]
+    for (_, x), (_, y) in zip(a, b):
+        assert np.array_equal(x.firm_idx, y.firm_idx)
+        assert np.array_equal(x.weight, y.weight)
+    c = s.bucketed_epoch(2)  # different shuffle, SAME shapes
+    for (ka, x), (kc, y) in zip(a, c):
+        assert ka == kc and x.firm_idx.shape == y.firm_idx.shape
+    assert any(not np.array_equal(x.firm_idx, y.firm_idx)
+               for (_, x), (_, y) in zip(a, c))
+    assert (sum(x.firm_idx.shape[0] for _, x in a)
+            == s.bucketed_batches_per_epoch())
+
+
+def test_lookback_rung_respects_history_gaps(panel):
+    """A firm with a valid month DEEP in the window must pin its months
+    to the full window — counting valid months alone would truncate
+    gapped histories and break bit-parity."""
+    s = DateBatchSampler(panel, 12, 4, 32, seed=0)
+    months = s._all_dates
+    rung = s._safe_lookback_rung(months)
+    full = np.cumsum(s._valid.astype(np.int64), axis=1)
+    for t in months[:40]:
+        t = int(t)
+        pool = s._firms_by_date[t]
+        r = rung[t]
+        if r < s.window:
+            lo = max(0, t - s.window + 1)
+            hi = t - r  # inclusive end of the dropped gap
+            if hi >= lo:
+                gap = (full[pool, hi]
+                       - (full[pool, lo - 1] if lo else 0)).max()
+                assert gap == 0
+
+
+# ---- bit-parity vs max-shape padding --------------------------------------
+
+
+def _pad_train_batch(b, bf):
+    """Pad a [D, w] train batch to [D, bf] with weight-0 repeats of the
+    first column — the max-shape twin of the same batch."""
+    d, w = b.firm_idx.shape
+    fi = np.concatenate(
+        [b.firm_idx,
+         np.repeat(b.firm_idx[:, :1], bf - w, axis=1)], axis=1)
+    wt = np.concatenate(
+        [b.weight, np.zeros((d, bf - w), np.float32)], axis=1)
+    return fi, b.time_idx, wt
+
+
+def test_train_step_bit_parity(panel, tmp_path, monkeypatch):
+    """One bucketed multi-step dispatch == the same batch padded to max
+    shape through the max-shape program: loss and updated params bit
+    identical (GRU as well as MLP — the masked-scan contract)."""
+    for kind in ("mlp", "gru"):
+        monkeypatch.setenv("LFM_BUCKETS", "1")
+        tr = Trainer(_cfg(tmp_path, kind=kind), _splits(panel))
+        state = tr.init_state()
+        parts = tr.train_sampler.bucketed_epoch(0)
+        # A genuinely narrow bucket (below the cap) when one exists.
+        bucket, b = min(parts, key=lambda p: p[0][1] * p[0][0])
+        bp = tr.programs.bucket_programs(tr.program_key, bucket)
+        one = lambda a: jnp.asarray(a[:1])  # [1, D, w] single-step stack
+        st_b, ms_b = bp._jit_multi_step(
+            jax.tree.map(jnp.copy, state), tr.dev,
+            one(b.firm_idx), one(b.time_idx), one(b.weight))
+        fi, ti, wt = _pad_train_batch(
+            dataclasses.replace(b, firm_idx=b.firm_idx[0],
+                                time_idx=b.time_idx[0],
+                                weight=b.weight[0]),
+            tr.cfg.data.firms_per_date)
+        st_m, ms_m = tr._jit_multi_step(
+            jax.tree.map(jnp.copy, state), tr.dev,
+            jnp.asarray(fi[None]), jnp.asarray(ti[None]),
+            jnp.asarray(wt[None]))
+        assert np.array_equal(np.asarray(ms_b["loss"]),
+                              np.asarray(ms_m["loss"])), kind
+        for a, c in zip(jax.tree.leaves(st_b.params),
+                        jax.tree.leaves(st_m.params)):
+            assert np.array_equal(np.asarray(a), np.asarray(c)), kind
+        reuse.clear_program_cache()
+        clear_panel_cache()
+
+
+def test_eval_forward_bit_parity(panel, tmp_path, monkeypatch):
+    """Bucketed eval forward == max-shape eval forward per month:
+    forecasts at real cells and per-month ICs bit-identical."""
+    monkeypatch.setenv("LFM_BUCKETS", "1")
+    tr = Trainer(_cfg(tmp_path, kind="gru"), _splits(panel))
+    params = tr.init_state().params
+    vb = tr.val_sampler.stacked_cross_sections()
+    pred_m, ic_m, _ = tr._jit_forward(
+        params, tr.dev, jnp.asarray(vb.firm_idx), jnp.asarray(vb.time_idx),
+        jnp.asarray(vb.weight))
+    pred_m, ic_m = np.asarray(pred_m), np.asarray(ic_m)
+    for bucket, b, pos in tr.val_sampler.bucketed_cross_sections():
+        bp = tr.programs.bucket_programs(tr.program_key, bucket)
+        pred_b, ic_b, _ = bp._jit_forward(
+            params, tr.dev, jnp.asarray(b.firm_idx),
+            jnp.asarray(b.time_idx), jnp.asarray(b.weight))
+        pred_b, ic_b = np.asarray(pred_b), np.asarray(ic_b)
+        assert np.array_equal(ic_b, ic_m[pos])
+        real = b.weight > 0
+        w = real.shape[1]
+        assert np.array_equal(pred_b[real], pred_m[pos][:, :w][real])
+
+
+def test_stacked_grads_path_parity(panel, tmp_path, monkeypatch):
+    """The stacked engine's shared gradient code (_grads_impl — what the
+    per-run-operand hyper step consumes) honors the parity: a bucketed
+    batch's LOSS equals the max-shape-padded twin's bit-for-bit. The
+    gradients are pinned to last-ulp only: these standalone-jitted
+    programs let XLA pick width-dependent reduction tilings whose
+    partition boundaries re-associate the REAL rows (padding itself is
+    exact) — the production multi-step programs come out bit-equal end
+    to end (test_train_step_bit_parity pins params after an update),
+    which is the contract that matters."""
+    monkeypatch.setenv("LFM_BUCKETS", "1")
+    tr = Trainer(_cfg(tmp_path), _splits(panel))
+    state = tr.init_state()
+    bucket, b = min(tr.train_sampler.bucketed_epoch(0),
+                    key=lambda p: p[0][1] * p[0][0])
+    lb, _w = bucket
+    g_b = jax.jit(lambda s, f, t, w: tr.programs._grads_impl(
+        s, tr.dev, f, t, w, window=lb))(
+            state, jnp.asarray(b.firm_idx[0]), jnp.asarray(b.time_idx[0]),
+            jnp.asarray(b.weight[0]))
+    fi, ti, wt = _pad_train_batch(
+        dataclasses.replace(b, firm_idx=b.firm_idx[0],
+                            time_idx=b.time_idx[0], weight=b.weight[0]),
+        tr.cfg.data.firms_per_date)
+    g_m = jax.jit(lambda s, f, t, w: tr.programs._grads_impl(
+        s, tr.dev, f, t, w))(
+            state, jnp.asarray(fi), jnp.asarray(ti), jnp.asarray(wt))
+    assert np.array_equal(np.asarray(g_b[0]), np.asarray(g_m[0]))
+    for a, c in zip(jax.tree.leaves(g_b[1]), jax.tree.leaves(g_m[1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=1e-6, atol=1e-8)
+
+
+def test_predict_bit_identical_to_max_shape(panel, tmp_path, monkeypatch):
+    """Pure inference: bucketed predict == max-shape predict for the
+    same params, over the whole panel scatter (single-seed + ensemble)."""
+    from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+    cfg = _cfg(tmp_path, kind="gru")
+    tr = Trainer(cfg, _splits(panel))
+    tr.state = tr.init_state()
+    pred0, valid0 = tr.predict()
+    monkeypatch.setenv("LFM_BUCKETS", "1")
+    trb = Trainer(cfg, _splits(panel))
+    trb.state = tr.state
+    predb, validb = trb.predict()
+    assert np.array_equal(pred0, predb) and np.array_equal(valid0, validb)
+
+    cfg2 = _cfg(tmp_path, n_seeds=2)
+    etb = EnsembleTrainer(cfg2, _splits(panel))
+    etb.state = etb.init_state()
+    pe_b, ve_b = etb.predict()
+    monkeypatch.delenv("LFM_BUCKETS")
+    reuse.clear_program_cache()
+    et = EnsembleTrainer(cfg2, _splits(panel))
+    et.state = etb.state
+    pe, ve = et.predict()
+    assert np.array_equal(pe, pe_b) and np.array_equal(ve, ve_b)
+
+
+# ---- compile-once / reuse contract ---------------------------------------
+
+
+@pytest.mark.reuse
+def test_warm_bucketed_fit_zero_traces_zero_h2d(panel, tmp_path,
+                                                monkeypatch):
+    """The reuse-lane guard with bucketing ON: a warm same-geometry
+    bucketed fit pays zero jit traces, zero panel H2D, zero program
+    rebuilds — and exactly ONE host sync per epoch (the PR 3 contract
+    through the per-bucket dispatch chain)."""
+    monkeypatch.setenv("LFM_BUCKETS", "1")
+    epochs = 3
+    tr = Trainer(_cfg(tmp_path, epochs=epochs), _splits(panel))
+    tr.fit()  # cold: every bucket program traces once
+    snap = REUSE_COUNTERS.snapshot()
+    tr.rebind(splits=_splits(panel))
+    fit = tr.fit()
+    d = REUSE_COUNTERS.delta(snap)
+    assert fit["epochs_run"] == epochs
+    assert d["jit_traces"] == 0
+    assert d["panel_transfers"] == 0
+    assert d["program_cache_misses"] == 0
+    assert d["host_syncs"] == epochs
+
+
+def test_bucketed_fit_trains_and_val_ic_matches_max_shape_eval(
+        panel, tmp_path, monkeypatch):
+    """End-to-end bucketed fit sanity + the val-sweep parity corollary:
+    the recorded val IC of the final state equals the max-shape
+    evaluate() of the same params (per-month ICs are bit-identical, and
+    finish() aggregates them identically)."""
+    monkeypatch.setenv("LFM_BUCKETS", "1")
+    tr = Trainer(_cfg(tmp_path, kind="gru"), _splits(panel))
+    fit = tr.fit()
+    assert fit["epochs_run"] == 2
+    assert np.isfinite(fit["history"][-1]["val_ic"])
+    monkeypatch.delenv("LFM_BUCKETS")
+    reuse.clear_program_cache()
+    tr2 = Trainer(_cfg(tmp_path, kind="gru"), _splits(panel))
+    ev = tr2.evaluate(tr.state.params)
+    assert fit["history"][-1]["val_ic"] == pytest.approx(ev["ic"], abs=0)
+
+
+def test_bucketed_steps_drive_schedule_and_harness(panel, tmp_path,
+                                                   monkeypatch):
+    """The LR-schedule horizon and FitHarness step arithmetic follow the
+    BUCKETED step count (per-bucket flooring), not the max-shape one."""
+    monkeypatch.setenv("LFM_BUCKETS", "1")
+    tr = Trainer(_cfg(tmp_path), _splits(panel))
+    want = tr.train_sampler.bucketed_batches_per_epoch()
+    assert tr._steps_per_epoch == want
+    assert tr.program_key[5][-1] == want  # optimizer tuple's last field
+    fit = tr.fit()
+    assert fit["steps"] == want * fit["epochs_run"]
+
+
+# ---- loud degrade (stacked engines) --------------------------------------
+
+
+def test_stacked_sweep_degrades_loudly_under_buckets(panel, tmp_path,
+                                                     monkeypatch):
+    from lfm_quant_tpu.train.stacked import run_config_sweep
+    from lfm_quant_tpu.utils import telemetry
+
+    monkeypatch.setenv("LFM_BUCKETS", "1")
+    before = telemetry.COUNTERS.get("stack_degrades")
+    grid = [{"lr": 1e-3}, {"lr": 5e-4}]
+    with pytest.warns(UserWarning, match="LFM_BUCKETS"):
+        summary = run_config_sweep(_cfg(tmp_path), grid, panel=panel,
+                                   out_dir=str(tmp_path / "sweep"))
+    assert summary["stacked"] is None  # sequential (bucketed) path ran
+    assert telemetry.COUNTERS.get("stack_degrades") == before + 1
+    assert len(summary["runs"]) == 2
+    assert all(np.isfinite(r["best_val_ic"]) for r in summary["runs"])
+
+
+# ---- fold × config product driver (satellite) ----------------------------
+
+
+def test_walkforward_sweep_product(panel, tmp_path):
+    """--sweep-grid × --walk-forward wiring: the F × C product trains as
+    ONE stack (per-run (cfg, splits) pairs) and the summary ranks
+    configs by mean best val IC across folds."""
+    from lfm_quant_tpu.train.stacked import run_walkforward_sweep
+
+    grid = [{"lr": 1e-3}, {"lr": 3e-4}]
+    out = str(tmp_path / "wfs")
+    summary = run_walkforward_sweep(
+        _cfg(tmp_path, epochs=2), grid, panel=panel, start=198001,
+        step_months=12, val_months=24, n_folds=2, train_months=60,
+        out_dir=out)
+    assert summary["n_folds"] == 2 and summary["n_configs"] == 2
+    assert summary["stacked"] and summary["stacked"]["enabled"]
+    assert summary["stacked"]["run_count"] == 4
+    assert len(summary["by_config"]) == 2
+    for bc in summary["by_config"]:
+        assert len(bc["per_fold"]) == 2
+        assert bc["mean_best_val_ic"] == pytest.approx(
+            np.mean(bc["per_fold"]))
+    assert summary["best_config"] == grid[summary["best_index"]]
+    for k in range(2):
+        for j in range(2):
+            rd = os.path.join(out, f"fold_{k}", f"config_{j:03d}")
+            assert os.path.exists(os.path.join(rd, "config.json"))
+    assert os.path.exists(os.path.join(out, "sweep_summary.json"))
+
+
+def test_walkforward_sweep_cli_guard():
+    """Parse-time guard: the product mode rejects stitching-only flags."""
+    import train as train_cli
+
+    with pytest.raises(SystemExit):
+        train_cli.main(["--preset", "c1", "--walk-forward", "12",
+                        "--sweep-grid", "lr=1e-3,5e-4",
+                        "--wf-score", "mean"])

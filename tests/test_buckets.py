@@ -71,6 +71,42 @@ def test_serve_program_key_no_collisions():
     assert all(k[0] == "serve" for k in keys)
 
 
+@pytest.mark.bucketed
+def test_train_bucket_key_family_collision_free():
+    """The TRAINING geometry-bucket key family (PR 8,
+    ``reuse.train_bucket_program_key``) cannot collide with any existing
+    family — trainer/ensemble/foldstack/stacked/serve — nor with itself
+    across distinct (inner, bucket) pairs. Critically, a serve bucket
+    and a train bucket with the SAME numbers are DIFFERENT keys (serve's
+    is (rows, width), train's is (lookback, width) — only the leading
+    tag separates them, and it does)."""
+    inner = ("trainer", "cpu", ("geometry", 1))
+    ens = ("ensemble", inner, None, 4, 0)
+    keys = [
+        reuse.train_bucket_program_key(inner, (8, 64)),
+        reuse.train_bucket_program_key(inner, (64, 8)),   # dims swapped
+        reuse.train_bucket_program_key(inner, (16, 64)),
+        reuse.train_bucket_program_key(ens, (8, 64)),     # ensemble twin
+        reuse.serve_program_key(inner, (8, 64)),          # same numbers!
+        reuse.foldstack_program_key(inner, None, 8, 64),
+        reuse.stacked_program_key(inner, None, 8, 64, "config", ()),
+        reuse.ensemble_program_key(inner, None, 8, 64),
+    ]
+    assert len(set(keys)) == len(keys), keys
+    tags = {k[0] for k in keys}
+    assert tags == {"trainbucket", "serve", "foldstack", "stacked",
+                    "ensemble"}
+    # The shared ladder helpers serve re-exports ARE the shared module's
+    # (promotion left one implementation, not a fork).
+    from lfm_quant_tpu import buckets as shared
+
+    assert buckets.next_pow2 is shared.next_pow2
+    assert buckets.bucket_width is shared.bucket_width
+    assert buckets.rows_ladder is shared.rows_ladder
+    assert buckets.width_ladder is shared.width_ladder
+    assert buckets.MIN_WIDTH == shared.MIN_WIDTH
+
+
 @pytest.mark.stacked
 def test_stacked_program_key_families_collision_free():
     """The three stacked program-key families — foldstack, generic

@@ -81,7 +81,14 @@ def main(argv=None) -> int:
                          "LFM_SWEEP_STACKED=0 forces the sequential "
                          "per-config reference; per-config run dirs + "
                          "sweep_summary.json land under "
-                         "<out>/<name>/sweep")
+                         "<out>/<name>/sweep. COMPOSES with "
+                         "--walk-forward: the fold × config PRODUCT "
+                         "trains as one stack (each run carries its own "
+                         "(cfg, splits) pair; use --wf-train-months so "
+                         "folds stay same-shape/stackable) and "
+                         "sweep_summary.json ranks configs by mean best "
+                         "val IC across folds — run dirs under "
+                         "<out>/<name>/wf_sweep/fold_<k>/config_<j>")
     ap.add_argument("--wf-score", metavar="MODES", default=None,
                     help="grade the stitched out-of-sample panel at the "
                          "end of the sweep: comma-separated aggregation "
@@ -109,10 +116,13 @@ def main(argv=None) -> int:
                  "finalize; the warm-start carry is serial)")
     sweep_grid = None
     if args.sweep_grid is not None:
-        if args.walk_forward is not None:
-            ap.error("--sweep-grid and --walk-forward are separate "
-                     "workloads (compose fold × config grids via "
-                     "train/stacked.py StackedRuns directly)")
+        if args.walk_forward is not None and (
+                args.wf_foldstack or args.wf_warm_start
+                or args.wf_score is not None):
+            ap.error("--sweep-grid × --walk-forward selects configs "
+                     "(no stitching), so --wf-foldstack/--wf-warm-start/"
+                     "--wf-score don't apply — pick the winning config "
+                     "here, then run the plain walk-forward with it")
         if args.resume:
             ap.error("--sweep-grid is incompatible with --resume (the "
                      "stacked sweep writes config checkpoints only at "
@@ -193,7 +203,9 @@ def main(argv=None) -> int:
     # telemetry run scope (manifest.json at start; spans.jsonl +
     # trace.json + ledger.jsonl over the run) covers the whole run.
     # LFM_TELEMETRY=0 makes the scope a no-op.
-    if args.walk_forward is not None:
+    if args.walk_forward is not None and sweep_grid is not None:
+        run_dir = os.path.join(cfg.out_dir, cfg.name, "wf_sweep")
+    elif args.walk_forward is not None:
         run_dir = os.path.join(cfg.out_dir, cfg.name, "wf")
     elif sweep_grid is not None:
         run_dir = os.path.join(cfg.out_dir, cfg.name, "sweep")
@@ -209,7 +221,21 @@ def main(argv=None) -> int:
         ctx.enter_context(trace_context(args.profile))
         ctx.enter_context(telemetry.run_scope(
             run_dir, cfg, extra={"entry": "train"}))
-        if args.walk_forward is not None:
+        if args.walk_forward is not None and sweep_grid is not None:
+            from lfm_quant_tpu.train.loop import resolve_panel
+            from lfm_quant_tpu.train.stacked import run_walkforward_sweep
+
+            panel = resolve_panel(cfg.data)
+            start = args.wf_start or int(
+                panel.dates[int(panel.n_months * 0.6)])
+            summary = run_walkforward_sweep(
+                cfg, sweep_grid, panel=panel, start=start,
+                step_months=args.walk_forward,
+                val_months=args.wf_val_months, n_folds=args.wf_folds,
+                train_months=args.wf_train_months, out_dir=run_dir,
+                echo=args.echo)
+            summary["run_dir"] = run_dir
+        elif args.walk_forward is not None:
             from lfm_quant_tpu.train.loop import resolve_panel
             from lfm_quant_tpu.train.walkforward import run_walkforward
 
