@@ -121,6 +121,20 @@ def _backend_name() -> str:
         return "unknown"
 
 
+def _precision_name() -> str:
+    """The resolved compute-precision lane a JUST-COMPLETED measurement
+    ran under (LFM_PRECISION env resolution — stage-local config
+    overrides tag their rows explicitly via the ``dtype`` extra). Pure
+    env read, no jax import — safe on every emit path including the
+    wedged-tunnel status records."""
+    try:
+        from lfm_quant_tpu.config import resolve_precision
+
+        return resolve_precision()
+    except Exception:  # noqa: BLE001 — a tag, never worth crashing for
+        return "unknown"
+
+
 def _emit(metric: str, value: float, mfu_pct: float, **extras) -> None:
     base = _baseline(metric)
     rec = {
@@ -130,6 +144,11 @@ def _emit(metric: str, value: float, mfu_pct: float, **extras) -> None:
         "vs_baseline": round(value / base, 3) if base > 0 else 1.0,
         "mfu_pct": round(mfu_pct, 2),
         "backend": _backend_name(),
+        # Compute precision of the measurement (DESIGN.md §17): makes
+        # mixed-precision rows distinguishable from the f32 trajectory
+        # in the same ledger. Stages that flip the lane per phase
+        # override this via an explicit ``dtype`` extra.
+        "dtype": _precision_name(),
     }
     rec.update(extras)
     print(json.dumps(rec), flush=True)
@@ -868,6 +887,189 @@ def bench_bucketed_train() -> None:
     _emit("bucketed_train", med_rate, 0.0, **extras)
 
 
+def bench_mixed_precision() -> None:
+    """mixed_precision — the LFM_PRECISION lane metric (DESIGN.md §17):
+    epochs/hour and measured params/panel/opt-state bytes with the
+    whole-stack bf16 lane ON vs the f32 reference, on the same panel and
+    seeds.
+
+    What the row must prove, each gated before anything is recorded:
+
+    * **footprint** — the resident working set (master params + Adam
+      moments + packed device panel) drops ≥1.8× measured from the live
+      arrays' avals, AND the ledger's ``arg_bytes`` for the traced
+      multi-step program shrinks (the 2× panel drop seen by the actual
+      compiled dispatch — "ledger-verified"). Params and moments bytes
+      are reported UNCHANGED on purpose: equal numbers are the
+      masters-stay-f32 invariant made visible; the reduction comes from
+      the panel, which dominates any production working set and every
+      serve-zoo residency budget.
+    * **parity** — best val IC within the pre-registered tolerance
+      (``LFM_BENCH_AMP_IC_TOL``, default 0.02) of the f32 fit, with the
+      early-stop DECISIONS exact (same best epoch, same stop epoch):
+      f32 reductions + f32 head boundary keep decision numerics off the
+      bf16 path entirely.
+    * **reuse** — warm bf16 fits pay zero jit traces and zero panel H2D
+      (the reuse-lane contract with the knob ON).
+
+    Median-of-3 per BASELINE.md. CPU fallback when the tunnel is wedged:
+    the footprint/parity/reuse halves are backend-independent;
+    epochs/hour on CPU prices loop structure only (XLA CPU emulates
+    bf16, so the speed column is a real-chip claim — the row's backend
+    says which it was)."""
+    import jax
+    import numpy as np
+
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+    from lfm_quant_tpu.data import synthetic_panel
+    from lfm_quant_tpu.data.panel import PanelSplits
+    from lfm_quant_tpu.train import reuse
+    from lfm_quant_tpu.utils import telemetry
+    from lfm_quant_tpu.train.loop import Trainer
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    n_epochs = int(os.environ.get("LFM_BENCH_AMP_EPOCHS", "8"))
+    ic_tol = float(os.environ.get("LFM_BENCH_AMP_IC_TOL", "0.02"))
+    cfg = RunConfig(
+        name="mixed_precision_bench",
+        data=DataConfig(n_firms=400, n_months=160, n_features=20,
+                        window=12, dates_per_batch=4, firms_per_date=64),
+        model=ModelConfig(kind="gru", kwargs={"hidden": 8}),
+        optim=OptimConfig(lr=1e-3, epochs=n_epochs, warmup_steps=5,
+                          early_stop_patience=2, loss="mse"),
+        seed=0,
+    )
+    panel = synthetic_panel(n_firms=400, n_months=160, n_features=20,
+                            seed=11)
+    splits = PanelSplits.by_date(panel, int(panel.dates[100]),
+                                 int(panel.dates[124]))
+
+    def tree_bytes(tree):
+        return int(sum(x.size * x.dtype.itemsize
+                       for x in jax.tree.leaves(tree)
+                       if hasattr(x, "size") and hasattr(x, "dtype")))
+
+    prev = os.environ.get("LFM_PRECISION")
+
+    def lane(precision: str):
+        """One precision lane: warmup fit (compiles), timed warm fits,
+        byte accounting, ledger arg_bytes of the traced multi-step."""
+        os.environ["LFM_PRECISION"] = precision
+        try:
+            led0 = len(telemetry.program_ledger())
+            tr = Trainer(cfg, splits)
+            summary = tr.fit()  # warmup: compile + the parity fit
+            multi = [e for e in telemetry.program_ledger()[led0:]
+                     if e["program"].startswith("multi_step")]
+            arg_bytes = max((e.get("arg_bytes") or 0) for e in multi) \
+                if multi else None
+            snap = REUSE_COUNTERS.snapshot()
+            times = []
+            reps = max(1, int(os.environ.get("LFM_BENCH_OUTER_REPS", "3")))
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                tr.fit()
+                times.append(time.perf_counter() - t0)
+            warm = REUSE_COUNTERS.delta(snap)
+            return {
+                "summary": summary,
+                "times": times,
+                "warm_traces": int(warm.get("jit_traces", 0)),
+                "warm_h2d": int(warm.get("panel_transfers", 0)),
+                "params_bytes": tree_bytes(tr.state.params),
+                "opt_bytes": tree_bytes(tr.state.opt_state),
+                "panel_bytes": tree_bytes(tr.dev),
+                "arg_bytes": arg_bytes,
+                "epochs_run": summary["epochs_run"],
+            }
+        finally:
+            if prev is None:
+                os.environ.pop("LFM_PRECISION", None)
+            else:
+                os.environ["LFM_PRECISION"] = prev
+
+    rtt = dispatch_rtt_ms()
+    try:
+        f32 = lane("f32")
+        b16 = lane("bf16")
+    finally:
+        reuse.clear_program_cache()
+
+    # ---- gates (nothing recorded unless every one holds) -------------
+    s32, s16 = f32["summary"], b16["summary"]
+    if (s16["best_epoch"] != s32["best_epoch"]
+            or s16["epochs_run"] != s32["epochs_run"]):
+        raise RuntimeError(
+            f"mixed-precision early-stop decisions diverged from f32 "
+            f"(best {s32['best_epoch']}→{s16['best_epoch']}, stop "
+            f"{s32['epochs_run']}→{s16['epochs_run']}) — row not recorded")
+    ic_diff = abs(float(s16["best_val_ic"]) - float(s32["best_val_ic"]))
+    if not np.isfinite(ic_diff) or ic_diff > ic_tol:
+        raise RuntimeError(
+            f"mixed-precision val IC off by {ic_diff:.4f} > tol {ic_tol} "
+            "— row not recorded")
+    if b16["warm_traces"] or b16["warm_h2d"]:
+        raise RuntimeError(
+            f"warm bf16 fits paid {b16['warm_traces']} traces / "
+            f"{b16['warm_h2d']} panel H2D — reuse contract broke with "
+            "LFM_PRECISION=bf16; row not recorded")
+    if b16["params_bytes"] != f32["params_bytes"] \
+            or b16["opt_bytes"] != f32["opt_bytes"]:
+        raise RuntimeError(
+            "master params / optimizer moments changed size under bf16 "
+            "— the f32-masters invariant broke; row not recorded")
+    tot32 = f32["params_bytes"] + f32["opt_bytes"] + f32["panel_bytes"]
+    tot16 = b16["params_bytes"] + b16["opt_bytes"] + b16["panel_bytes"]
+    reduction = tot32 / max(tot16, 1)
+    if reduction < 1.8:
+        raise RuntimeError(
+            f"measured footprint reduction {reduction:.2f}x < 1.8x — "
+            "row not recorded")
+    if (f32["arg_bytes"] and b16["arg_bytes"]
+            and not b16["arg_bytes"] < f32["arg_bytes"]):
+        raise RuntimeError(
+            "ledger arg_bytes did not shrink under bf16 — the compiled "
+            "dispatch never saw the footprint drop; row not recorded")
+
+    t16 = _median(b16["times"])
+    t32 = _median(f32["times"])
+    rates = sorted(3600.0 * b16["epochs_run"] / max(t, 1e-9)
+                   for t in b16["times"])
+    med_rate = 3600.0 * b16["epochs_run"] / max(t16, 1e-9)
+    extras = {
+        "unit": "epochs/hour",
+        "dtype": "bf16",  # the lane measured; the f32 twin is below
+        "n_epochs": b16["epochs_run"],
+        "f32_epochs_per_hour": round(
+            3600.0 * f32["epochs_run"] / max(t32, 1e-9), 1),
+        "speedup_vs_f32": round(t32 / max(t16, 1e-9), 3),
+        "bytes_reduction": round(reduction, 3),
+        "params_bytes": f32["params_bytes"],          # equal by gate —
+        "opt_state_bytes": f32["opt_bytes"],          # f32 masters
+        "panel_bytes_f32": f32["panel_bytes"],
+        "panel_bytes_bf16": b16["panel_bytes"],
+        "ledger_arg_bytes_f32": f32["arg_bytes"],
+        "ledger_arg_bytes_bf16": b16["arg_bytes"],
+        "best_val_ic_f32": round(float(s32["best_val_ic"]), 5),
+        "best_val_ic_bf16": round(float(s16["best_val_ic"]), 5),
+        "ic_diff": round(ic_diff, 5),
+        "ic_tol": ic_tol,
+        "best_epoch": s16["best_epoch"],
+        "early_stop_epochs_run": s16["epochs_run"],
+        "warm_traces_bf16": b16["warm_traces"],
+        "warm_panel_h2d_bf16": b16["warm_h2d"],
+        "n_reps": len(b16["times"]),
+    }
+    if len(rates) >= 2:
+        extras["spread_pct"] = round(
+            100.0 * (rates[-1] - rates[0]) / max(med_rate, 1e-9), 1)
+        extras["rep_values"] = [round(v, 1) for v in rates]
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("mixed_precision", med_rate, 0.0, **extras)
+
+
 def _cpu_metric_fallback(flag: str, budget_s: float) -> bool:
     """Wedged-tunnel fallback for a backend-independent metric: the
     quantities walkforward_reuse (compiles/transfers per warm fold) and
@@ -1354,6 +1556,10 @@ def _emit_status(status: str, persist: bool = True, **extras) -> None:
         "unit": "status",
         "vs_baseline": 1.0,
         "status": status,
+        # dtype (but NOT backend): the precision tag is a pure env read,
+        # while a backend query could hang on the wedged-tunnel path
+        # this record exists for (see persist_row).
+        "dtype": _precision_name(),
     }
     rec.update(extras)
     print(json.dumps(rec), flush=True)
@@ -1594,8 +1800,8 @@ def main() -> int:
                     and probe.get("kind") == "tunnel_wedged"):
                 for flag in ("--walkforward-reuse", "--walkforward-foldstack",
                              "--config-sweep", "--bucketed-train",
-                             "--scoring-pipeline", "--epoch-pipeline",
-                             "--serve"):
+                             "--mixed-precision", "--scoring-pipeline",
+                             "--epoch-pipeline", "--serve"):
                     _cpu_metric_fallback(
                         flag,
                         deadline_s - (time.monotonic() - t_start) - 30.0)
@@ -1653,6 +1859,14 @@ def main() -> int:
             print(f"bench_bucketed_train failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             _emit_status("bench_error", stage="bucketed_train",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
+        try:
+            bench_mixed_precision()
+        except Exception as e:  # noqa: BLE001 — earlier rows must still reach the driver
+            print(f"bench_mixed_precision failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            _emit_status("bench_error", stage="mixed_precision",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
         try:
@@ -1718,6 +1932,9 @@ if __name__ == "__main__":
     if "--bucketed-train" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_bucketed_train,
                                      "bucketed_train"))
+    if "--mixed-precision" in sys.argv[1:]:
+        sys.exit(_single_metric_main(bench_mixed_precision,
+                                     "mixed_precision"))
     if "--scoring-pipeline" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_scoring_pipeline,
                                      "scoring_pipeline"))

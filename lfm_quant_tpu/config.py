@@ -11,7 +11,48 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Any, Dict, Optional, Tuple
+
+#: The two supported compute-precision lanes (DESIGN.md §17).
+PRECISIONS = ("f32", "bf16")
+
+
+def resolve_precision(cfg: Optional["RunConfig"] = None) -> str:
+    """Resolve the whole-stack compute-precision lane: an explicit
+    ``RunConfig.precision`` wins, else the ``LFM_PRECISION`` env knob,
+    else ``"f32"``. With no ``cfg`` this is the pure env resolution —
+    the zero-arg form the telemetry manifest probes.
+
+    ``"bf16"`` selects the mixed-precision lane end to end: bf16 model
+    compute (f32 master params — Flax param dtype is untouched), bf16
+    device-panel residency, f32 reductions/decisions (DESIGN.md §17).
+    ``"f32"`` (the default) leaves every per-model ``ModelConfig.bf16``
+    choice exactly as configured — the pre-lane behavior.
+    """
+    p = ((cfg.precision if cfg is not None else "")
+         or os.environ.get("LFM_PRECISION", "")) or "f32"
+    if p not in PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {PRECISIONS}, got {p!r} "
+            "(RunConfig.precision / LFM_PRECISION)")
+    return p
+
+
+def compute_dtype(cfg: "RunConfig"):
+    """The effective COMPUTE dtype for a config — ``jnp.bfloat16`` when
+    either the per-model ``ModelConfig.bf16`` flag or the whole-stack
+    precision lane selects bf16, else None (f32 compute). The single
+    source every dtype consumer reads: model construction
+    (:func:`model_kwargs`), device-panel residency
+    (``data/windows.py cached_device_panel``), gather resolution, the
+    serving zoo's panel leases and the stacked engines' stack-mesh
+    panel — so no path can disagree about the lane."""
+    import jax.numpy as jnp
+
+    if cfg.model.bf16 or resolve_precision(cfg) == "bf16":
+        return jnp.bfloat16
+    return None
 
 
 @dataclasses.dataclass
@@ -122,6 +163,13 @@ class RunConfig:
     # LSTM/GRU recurrence cannot window-shard); currently exclusive with
     # n_data_shards/n_seeds meshes; window must divide by it.
     n_seq_shards: int = 1
+    # Compute-precision lane (DESIGN.md §17): "" = inherit the
+    # LFM_PRECISION env knob (default f32); "bf16" selects mixed
+    # precision end to end — bf16 model compute + bf16 panel residency
+    # with f32 master params, f32 Adam moments and f32 reductions.
+    # Resolved via config.resolve_precision(cfg); a member of every
+    # program-cache key family (train/reuse.py trainer_program_key).
+    precision: str = ""
     # Seed microbatching: >0 scans the (per-device) seed stack in blocks
     # of this size inside the train step, bounding activation memory to
     # seed_block × per-seed instead of all resident seeds at once — the
@@ -161,6 +209,7 @@ class RunConfig:
             n_seeds=raw.get("n_seeds", 1),
             n_data_shards=raw.get("n_data_shards", 1),
             n_seq_shards=raw.get("n_seq_shards", 1),
+            precision=raw.get("precision", ""),
             seed_block=raw.get("seed_block", 0),
             compilation_cache_dir=raw.get("compilation_cache_dir"),
             out_dir=raw.get("out_dir", "runs"),
@@ -302,7 +351,11 @@ def model_kwargs(cfg: RunConfig, mesh=None,
 
     del mesh  # kept in the signature: callers resolve per execution context
     kw = dict(cfg.model.kwargs)
-    if cfg.model.bf16:
+    # Compute dtype: per-model bf16 flag OR the whole-stack precision
+    # lane (LFM_PRECISION=bf16, DESIGN.md §17). Param dtype stays f32
+    # either way — every model keeps f32 master params and an f32 head
+    # boundary; only trunk compute casts down.
+    if compute_dtype(cfg) is not None:
         kw["dtype"] = jnp.bfloat16
     if cfg.is_heteroscedastic:
         kw["heteroscedastic"] = True

@@ -673,8 +673,12 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
 
     ``xm`` is the hot-path packed panel: features with validity appended as
     one extra column (``[N, T, F+1]``), stored in ``compute_dtype`` (pass
-    the model's compute dtype — bf16 is numerically free for bf16 models,
-    which cast inputs anyway, and HALVES gather bytes). Packing exists
+    the model's compute dtype — trainers resolve it once via
+    ``config.compute_dtype``, which folds the per-model bf16 flag and
+    the whole-stack ``LFM_PRECISION`` lane together; bf16 is numerically
+    free for bf16-compute models, which cast inputs anyway, and HALVES
+    the resident-panel HBM, every gather's bytes and every panel H2D —
+    the mixed-precision lane's footprint win, DESIGN.md §17). Packing exists
     because a separate bool ``valid[firm_idx]`` gather profiled ~2× slower
     on TPU than the 80×-larger feature gather; one fused gather serves
     both.

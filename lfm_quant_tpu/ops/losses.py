@@ -22,7 +22,18 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def _acc(x):
+    """Promote sub-f32 values (bf16/f16) to f32 before reduction — the
+    mixed-precision lane's f32-reduction contract (DESIGN.md §17). In
+    practice the head already emits f32 and targets stay f32, so every
+    production loss reduces in f32 regardless; this pins the property
+    for any caller that hands raw bf16 tensors in. No-op for f32/f64."""
+    dt = jnp.promote_types(x.dtype, jnp.float32)
+    return x.astype(dt) if x.dtype != dt else x
+
+
 def _weighted_mean(x, w, axis=None):
+    x = _acc(x)
     w = w.astype(x.dtype)
     return (x * w).sum(axis=axis) / jnp.maximum(w.sum(axis=axis), 1e-12)
 
@@ -116,7 +127,8 @@ def finalize_loss(num, den):
 
 
 def _sum_parts(errs, w):
-    w = w.astype(errs.dtype)
+    errs = _acc(errs)  # f32 accumulators (see _acc) — num/den and their
+    w = w.astype(errs.dtype)  # psums must never accumulate in bf16
     return (errs * w).sum(), w.sum()
 
 

@@ -9,7 +9,18 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def _acc(x):
+    """Promote sub-f32 inputs (bf16/f16) to f32 before any reduction —
+    the mixed-precision lane's f32-accumulator contract (DESIGN.md §17):
+    ICs, ranks and error sums drive early-stop DECISIONS and must never
+    quantize at bf16's 8 mantissa bits. f32/f64 inputs pass through
+    untouched, so every existing full-precision path is bit-unchanged."""
+    dt = jnp.promote_types(x.dtype, jnp.float32)
+    return x.astype(dt) if x.dtype != dt else x
+
+
 def _masked_pearson(a, b, w):
+    a, b = _acc(a), _acc(b)
     w = w.astype(a.dtype)
     denom = jnp.maximum(w.sum(axis=-1, keepdims=True), 1e-12)
     ma = (a * w).sum(axis=-1, keepdims=True) / denom
@@ -41,6 +52,7 @@ def hard_ranks(x, w):
     paired against each aggregation mode's forecast ranks via
     :func:`pearson_ic` — ``spearman_ic`` is exactly that composition.
     """
+    x = _acc(x)  # bf16 ranks are exact only to n≈256 — rank in ≥f32
     big = jnp.asarray(jnp.finfo(x.dtype).max, x.dtype)
     xs = jnp.where(w > 0, x, big)
     order = jnp.argsort(xs, axis=-1)

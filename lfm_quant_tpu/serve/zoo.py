@@ -107,7 +107,17 @@ class ZooEntry:
             int(self.panel.dates[t]): int(t) for t in months}
         self._pool_sizes = {int(t): self._sampler.cross_section(int(t)).size
                             for t in months}
-        self._compute_dtype = jnp.bfloat16 if self.cfg.model.bf16 else None
+        # Precision lane: bind the TRAINER'S resolved compute dtype
+        # (config.compute_dtype at its construction) rather than
+        # re-resolving the env knob here — the entry's panel lease must
+        # key-match the resident panel the trainer's programs were
+        # traced against even if LFM_PRECISION flips mid-process. Under
+        # the bf16 lane that lease is a bf16 panel: half the
+        # per-universe HBM, so a zoo of fixed capacity holds twice the
+        # universes' panels per chip (DESIGN.md §17).
+        self._compute_dtype = getattr(
+            trainer, "_compute_dtype",
+            jnp.bfloat16 if self.cfg.model.bf16 else None)
         self._lane_pad = trainer._gather_impl == "pallas"
         # Per-bucket scoring programs, memoized HERE as well as in the
         # reuse LRU: an entry must keep its executables warm even if a

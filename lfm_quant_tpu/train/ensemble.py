@@ -348,6 +348,11 @@ class EnsembleTrainer:
         self.inner = Trainer(cfg, splits, run_dir=None, mesh=self.mesh)
         self.window = self.inner.window
         self.dev = self.inner.dev
+        # Precision lane (DESIGN.md §17): the seed stack rides the inner
+        # trainer's resolution — one bf16 resident panel shared by all
+        # seeds, f32 master params per member (vmapped init preserves
+        # leaf dtypes), f32 moments, f32 IC/loss reductions.
+        self._compute_dtype = self.inner._compute_dtype
         # Geometry-bucket mode rides the inner trainer's resolution
         # (LFM_BUCKETS; rejected under a live seq axis there). The
         # ensemble's GSPMD eval forward has no month-sharded variant, so

@@ -37,6 +37,13 @@ the engine owns the stacked execution. Its parity lane
   (``data/windows.py stack_fold_epochs``) and its own init key
   (``Trainer.init_stacked_states``), so fold k samples and initializes
   exactly as its sequential run would.
+* The precision lane (``LFM_PRECISION=bf16``, DESIGN.md §17) composes
+  transparently: the stacked state holds each fold's f32 MASTER params
+  and f32 moments over the one shared bf16 resident panel, the
+  device-side ``FoldCtrl`` early-stop control compares the f32 val ICs
+  the f32 head/reduction boundary produces (decisions stay exact), and
+  the lane reaches the fold-stack program key through the inner
+  trainer key it embeds — an env flip rebuilds, never stale reuse.
 
 Durability trade (documented, not hidden): the stacked fit writes NO
 per-epoch checkpoint lines — each fold's ``ckpt/best`` is unstacked from

@@ -977,6 +977,14 @@ class Trainer:
             seed=cfg.seed, min_valid_months=d.min_valid_months,
             min_cross_section=1, date_range=splits.val_range,
         )
+        # Compute-precision lane (LFM_PRECISION / RunConfig.precision,
+        # DESIGN.md §17): ONE resolution feeds the gather choice, the
+        # panel residency dtype and (via config.model_kwargs inside
+        # TrainerPrograms) the models' compute dtype — master params,
+        # Adam moments and every loss/IC reduction stay f32 regardless.
+        from lfm_quant_tpu.config import compute_dtype
+
+        self._compute_dtype = compute_dtype(cfg)
         # Gather implementation (Pallas DMA gather needs a lane-padded
         # panel, so it must be resolved before the device transfer). Under
         # a mesh the eval sweep keeps the XLA gather even though the
@@ -986,7 +994,7 @@ class Trainer:
         # the paths identical.
         self._gather_impl = resolve_gather_impl(
             d.gather_impl, self.mesh, splits.panel, d.window,
-            bf16=cfg.model.bf16)
+            bf16=self._compute_dtype is not None)
         if self._n_seq > 1:
             # Sequence-parallel steps gather only the shard's SUB-window
             # (window // n_seq months) — the Pallas DMA gather's aligned
@@ -1023,9 +1031,13 @@ class Trainer:
         # — AND, through the residency cache, every other trainer/fold
         # bound to the same (panel, mesh, dtype, padding): a walk-forward
         # sweep transfers the panel exactly once.
+        # Under the bf16 lane the resident packed panel is bf16: half
+        # the panel HBM and half of every panel H2D, shared (through the
+        # residency cache) by every trainer/fold/bucket/stack/serve
+        # program bound to the same (panel, mesh, dtype, padding).
         self.dev = cached_device_panel(
             splits.panel, self.mesh,
-            compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None,
+            compute_dtype=self._compute_dtype,
             raw=False, lane_pad=self._gather_impl == "pallas")
 
         # Cold-process reuse: point XLA's persistent compilation cache at

@@ -37,6 +37,13 @@ program, every input, and every recorded metric is identical to the
 lock-step loop — ``LFM_ASYNC=0/1`` produce the same epoch history, best
 epoch, early-stop epoch and restored best params (tests/test_pipeline.py
 pins this), which is why the knobs are not program-cache keys.
+
+Precision lane (``LFM_PRECISION=bf16``, DESIGN.md §17): nothing here
+changes — the driver's early-stop comparisons consume the f32 scalars
+the dispatch returns (f32 head boundary + ≥f32 reduction accumulators
+upstream), so the lookahead/lock-step decision parity above holds
+identically under mixed precision; the lane reaches this module only
+through the already-compiled programs it dispatches.
 """
 
 from __future__ import annotations

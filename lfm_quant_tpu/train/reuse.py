@@ -311,6 +311,7 @@ def trainer_program_key(cfg, mesh, n_seq: int, gather_impl: str,
     """
     import jax
 
+    from lfm_quant_tpu.config import resolve_precision
     from lfm_quant_tpu.parallel.mesh import mesh_fingerprint
 
     m, o, d = cfg.model, cfg.optim, cfg.data
@@ -334,6 +335,17 @@ def trainer_program_key(cfg, mesh, n_seq: int, gather_impl: str,
         # built with donation on must not be served to a trainer
         # constructed under LFM_DONATE=0 (and vice versa).
         donation_enabled(),
+        # Compute-precision lane (LFM_PRECISION / RunConfig.precision,
+        # DESIGN.md §17): bf16 compute + bf16 panel residency change the
+        # traced programs AND their numerics, so the RESOLVED lane is a
+        # tagged key member — and because every other program-key family
+        # (ensemble/foldstack/stacked/serve/trainbucket) embeds this
+        # inner key, the lane is a member of all of them by
+        # construction. An env flip mid-process therefore builds fresh
+        # programs, never reuses a stale-precision executable. Appended
+        # LAST so the key's positional layout (tests and tooling index
+        # the model/optim tuples) is unchanged.
+        ("precision", resolve_precision(cfg)),
     )
 
 
@@ -420,7 +432,7 @@ class _LedgeredJit:
     dispatch (nanoseconds against a multi-ms dispatch). A call that
     TRACED (detected via the :func:`count_traces` counter delta —
     Python trace == fresh XLA compile for these programs) records a
-    ledger entry: compile wall seconds (the whole first-call elapsed —
+    ledger entry: compile wall seconds (trace start → call return:
     trace + lower + XLA compile; jit blocks on compilation before
     dispatching) and, when a telemetry run is active, the program's XLA
     ``cost_analysis`` FLOPs/bytes and ``memory_analysis`` HBM footprint
@@ -431,7 +443,19 @@ class _LedgeredJit:
     lane's zero-trace contract must not see it.
 
     Everything analysis-shaped is guarded for jax-0.4.x availability:
-    any step that raises degrades to an entry without those fields."""
+    any step that raises degrades to an entry without those fields.
+
+    Stopwatch discipline: a WARM call reads the clock ZERO times — the
+    compile wall time is measured from the trace-start stamp
+    ``count_traces`` records (utils/profiling.py ``last_trace_t0``) to
+    one post-call read, both of which only happen when the call
+    actually traced. The pre-fix version read ``perf_counter`` once per
+    warm dispatch, which broke the tick parity of frozen-clock test
+    harnesses (an extra read landed a caller's ``t0``/``end`` pair on
+    the same tick → dt == 0 → ZeroDivisionError in the caller's rate
+    arithmetic; tests/test_train.py measure_eval had to pin
+    LFM_TELEMETRY=0). A degenerate dt is additionally guarded to 0.0
+    here rather than ever going negative."""
 
     __slots__ = ("name", "_jitted")
 
@@ -442,12 +466,26 @@ class _LedgeredJit:
     def __call__(self, *args, **kwargs):
         if not telemetry.enabled():
             return self._jitted(*args, **kwargs)
-        before = telemetry.COUNTERS.get("jit_traces")
-        t0 = time.perf_counter()
+        from lfm_quant_tpu.utils.profiling import (last_trace_t0,
+                                                   thread_trace_count)
+
+        # "This call traced" must be judged per THREAD: the global
+        # jit_traces counter can move on another thread (a zoo
+        # warmup/refresh compiling while a batcher thread dispatches
+        # warm), which would ledger dt measured from this thread's
+        # stale (or absent) stamp — unbounded wall-clock attributed to
+        # a compile that happened elsewhere. The thread-local trace
+        # count moves iff THIS thread traced (an integer, so it can't
+        # false-negative the way a repeated clock VALUE can under a
+        # monkeypatched test clock); reading it costs zero clock reads,
+        # preserving the warm-path tick-parity contract.
+        before = thread_trace_count()
         out = self._jitted(*args, **kwargs)
-        traces = telemetry.COUNTERS.get("jit_traces") - before
+        traces = thread_trace_count() - before
         if traces:
-            self._record(args, kwargs, time.perf_counter() - t0, traces)
+            t0 = last_trace_t0()
+            self._record(args, kwargs,
+                         max(time.perf_counter() - t0, 0.0), traces)
         return out
 
     def lower(self, *args, **kwargs):

@@ -645,10 +645,15 @@ class StackedRuns:
                 gather_impl = (self.trainer.inner._gather_impl
                                if self.ensemble
                                else self.trainer._gather_impl)
+                # Bind the trainer's RESOLVED compute dtype (same
+                # pattern as serve/zoo.py) rather than re-resolving the
+                # env knob here: the stack-mesh copy must match the
+                # dtype the compiled programs were traced against even
+                # if LFM_PRECISION flips between trainer construction
+                # and this panel build.
                 self.dev = cached_device_panel(
                     panel, self.mesh,
-                    compute_dtype=(jnp.bfloat16 if cfg.model.bf16
-                                   else None),
+                    compute_dtype=self.trainer._compute_dtype,
                     raw=False, lane_pad=gather_impl == "pallas")
         else:
             self.dev = self.trainer.dev  # same placement — zero extra H2D

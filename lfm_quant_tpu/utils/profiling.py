@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 import time
 import warnings
 from typing import Callable, Dict, Optional, Union
@@ -214,17 +215,46 @@ def suspend_trace_counting():
         _TRACE_COUNT_SUSPENDED = prev
 
 
+#: Per-thread trace-start stamp (perf_counter at the latest counted
+#: trace entry on this thread). The ledger stopwatch (train/reuse.py
+#: _LedgeredJit) reads it AFTER a call it detected as traced, so warm
+#: calls pay zero clock reads — both the near-zero-overhead contract
+#: and the tick-parity contract frozen-clock test harnesses rely on
+#: (an uncounted extra read per warm dispatch used to land a caller's
+#: interval on one tick and divide by zero).
+_TRACE_TLS = threading.local()
+
+
+def last_trace_t0() -> Optional[float]:
+    """perf_counter stamp of this thread's most recent counted trace
+    entry (None if the thread never traced a counted program)."""
+    return getattr(_TRACE_TLS, "t0", None)
+
+
+def thread_trace_count() -> int:
+    """This THREAD's counted-trace total. The ledger stopwatch compares
+    it across a dispatch to decide "this call traced on this thread" —
+    the global ``jit_traces`` counter can move on another thread, and
+    the t0 stamp VALUE can legitimately repeat under a monkeypatched
+    test clock, but this integer only moves when this thread traces."""
+    return getattr(_TRACE_TLS, "n", 0)
+
+
 def count_traces(name: str, fn: Callable) -> Callable:
     """Wrap the OUTERMOST callable handed to ``jax.jit`` so every trace
     bumps ``REUSE_COUNTERS.jit_traces``. The wrapper body runs exactly
     when jit traces (a cached executable skips Python entirely), so the
     counter equals the number of XLA compilations these programs cost.
+    Each counted trace also stamps :func:`last_trace_t0` — the ledger's
+    compile-stopwatch start, read only on calls that traced.
     ``functools.wraps`` keeps the signature visible for static_argnames
     resolution. ``name`` is for debuggability in tracebacks only."""
 
     @functools.wraps(fn)
     def traced(*args, **kwargs):
         if not _TRACE_COUNT_SUSPENDED:
+            _TRACE_TLS.t0 = time.perf_counter()
+            _TRACE_TLS.n = getattr(_TRACE_TLS, "n", 0) + 1
             COUNTERS.bump("jit_traces")
         return fn(*args, **kwargs)
 

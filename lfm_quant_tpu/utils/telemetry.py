@@ -457,6 +457,11 @@ _KNOB_PROBES = (
     ("foldstack", "lfm_quant_tpu.train.reuse", "foldstack_enabled"),
     ("buckets", "lfm_quant_tpu.buckets", "buckets_enabled"),
     ("jax_backtest", "lfm_quant_tpu.backtest", "jax_backtest_enabled"),
+    # Compute-precision lane (LFM_PRECISION, DESIGN.md §17): the env
+    # resolution ("f32"/"bf16") — per-config overrides additionally land
+    # in the manifest's config block. scripts/check_knobs.py pins that
+    # every probed knob here resolves.
+    ("precision", "lfm_quant_tpu.config", "resolve_precision"),
 )
 
 

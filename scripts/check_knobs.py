@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Static LFM_* knob-documentation cross-check (CI tooling).
+
+Every ``LFM_*`` environment variable the codebase READS must be
+documented in README.md, and every knob the telemetry run manifest
+PROBES (``utils/telemetry.py _KNOB_PROBES``) must resolve to a real
+function in a real module — otherwise a new knob (this repo grows one
+most PRs: LFM_BUCKETS, LFM_STACK_BLOCK, LFM_PRECISION, ...) can land
+invisible to operators and to the manifest's provenance record.
+
+Wholly static: sources are scanned with regex/ast, nothing is imported
+(no jax, no backend init — the check runs in milliseconds anywhere,
+including the wedged-tunnel box). Wired as a fast test in
+tests/test_amp.py, so an undocumented knob fails tier-1 before it
+lands.
+
+Scope rules:
+  * reads under ``tests/`` are exempt (test-local knobs like LFM_OTHER
+    are fixtures, not operator surface);
+  * a knob read ONLY under ``scripts/`` must be documented in the
+    script's own module docstring OR README (operator tooling documents
+    itself);
+  * everything else (lfm_quant_tpu/, top-level entry points) must
+    appear in README.md.
+
+Exit 0 = clean; exit 1 prints the offending knobs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: os.environ reads: .get("LFM_X"), ["LFM_X"] — pops/sets/dels are
+#: writes or cleanup, not operator-facing reads, and stay out on
+#: purpose (a knob that is only ever written is not a knob).
+_READ_RE = re.compile(
+    r"""os\.environ(?:\.get\(\s*|\[\s*)['"](LFM_[A-Z0-9_]+)['"]""")
+_TOKEN_RE = re.compile(r"LFM_[A-Z0-9_]+")
+
+
+def _py_files(root: str) -> List[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git", ".claude")]
+        out.extend(os.path.join(dirpath, f) for f in filenames
+                   if f.endswith(".py"))
+    return sorted(out)
+
+
+def env_reads(repo: str = REPO) -> Dict[str, Set[str]]:
+    """knob name → set of repo-relative files that READ it."""
+    reads: Dict[str, Set[str]] = {}
+    for path in _py_files(repo):
+        rel = os.path.relpath(path, repo)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        for name in _READ_RE.findall(src):
+            reads.setdefault(name, set()).add(rel)
+    return reads
+
+
+def documented_knobs(repo: str = REPO) -> Set[str]:
+    try:
+        with open(os.path.join(repo, "README.md"), encoding="utf-8") as fh:
+            return set(_TOKEN_RE.findall(fh.read()))
+    except OSError:
+        return set()
+
+
+def _module_docstring(path: str) -> str:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        return ast.get_docstring(tree) or ""
+    except (OSError, SyntaxError):
+        return ""
+
+
+def manifest_probes(repo: str = REPO) -> List[Tuple[str, str, str]]:
+    """The (name, module, fn) triples of ``_KNOB_PROBES``, read
+    statically (ast.literal_eval of the assignment) — no import."""
+    path = os.path.join(repo, "lfm_quant_tpu", "utils", "telemetry.py")
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "_KNOB_PROBES"
+                        for t in node.targets)):
+            return [tuple(x) for x in ast.literal_eval(node.value)]
+    raise AssertionError("_KNOB_PROBES not found in utils/telemetry.py")
+
+
+def check(repo: str = REPO) -> List[str]:
+    """All problems found (empty list = clean)."""
+    problems: List[str] = []
+    reads = env_reads(repo)
+    docs = documented_knobs(repo)
+
+    for name, files in sorted(reads.items()):
+        non_test = {f for f in files if not f.startswith("tests" + os.sep)}
+        if not non_test:
+            continue  # test-fixture knob (e.g. LFM_OTHER)
+        if name in docs:
+            continue
+        script_only = all(f.startswith("scripts" + os.sep)
+                          for f in non_test)
+        if script_only and all(
+                name in _module_docstring(os.path.join(repo, f))
+                for f in non_test):
+            continue  # operator tooling documenting its own knob
+        problems.append(
+            f"undocumented knob {name} (read in "
+            f"{', '.join(sorted(non_test))}) — add it to README.md")
+
+    # Manifest probes must resolve: module file exists and defines fn.
+    for name, mod, fn in manifest_probes(repo):
+        mpath = os.path.join(repo, *mod.split(".")) + ".py"
+        if not os.path.exists(mpath):
+            mpath = os.path.join(repo, *mod.split("."), "__init__.py")
+        if not os.path.exists(mpath):
+            problems.append(
+                f"manifest knob probe {name!r}: module {mod} has no file")
+            continue
+        with open(mpath, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        defs = {n.name for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        # Re-exports (e.g. lfm_quant_tpu.backtest.jax_backtest_enabled)
+        # surface as imported names, not defs.
+        imports = {a.asname or a.name for n in ast.walk(tree)
+                   if isinstance(n, ast.ImportFrom) for a in n.names}
+        if fn not in defs | imports:
+            problems.append(
+                f"manifest knob probe {name!r}: {mod}.{fn} not found")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    reads = env_reads()
+    print(f"[check_knobs] {len(reads)} LFM_* knobs read, "
+          f"{len(documented_knobs())} documented in README.md, "
+          f"{len(manifest_probes())} manifest probes")
+    for p in problems:
+        print(f"[check_knobs] FAIL: {p}")
+    if not problems:
+        print("[check_knobs] OK — every knob documented, every probe "
+              "resolves")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

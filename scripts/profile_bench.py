@@ -51,9 +51,13 @@ def main():
     from lfm_quant_tpu.data.windows import device_panel
     # lane_pad must match what Trainer.__init__ chose, or a pallas-resolved
     # gather re-pads the whole panel inside every profiled step.
+    # compute_dtype must match what Trainer.__init__ resolved (the
+    # per-model bf16 flag folded with the LFM_PRECISION lane) for the
+    # same reason as lane_pad: a dtype-mismatched panel gives every
+    # profiled step fresh avals and the profile measures compiles.
     trainer.dev = device_panel(
         splits.panel, None,
-        compute_dtype=jnp.bfloat16 if cfg.model.bf16 else None, raw=True,
+        compute_dtype=trainer._compute_dtype, raw=True,
         lane_pad=trainer._gather_impl == "pallas")
 
     b = trainer.train_sampler.stacked_epoch(0)

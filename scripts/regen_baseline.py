@@ -22,8 +22,11 @@ END = "<!-- AUTOGEN:BENCH_ROWS END -->"
 
 # Extras that define a DIFFERENT measurement (not just metadata): two rows
 # sharing metric but differing here are separate table lines. backend is a
-# key field so a CPU smoke run can never displace a chip capture.
-KEY_FIELDS = ("backend", "config", "n_seeds", "seed_block",
+# key field so a CPU smoke run can never displace a chip capture; dtype
+# (the resolved LFM_PRECISION lane, stamped on every row since PR 9) is
+# one so a bf16 capture can never displace — or be displaced by — the
+# f32 trajectory it is compared against.
+KEY_FIELDS = ("backend", "dtype", "config", "n_seeds", "seed_block",
               "dates_per_batch", "scan_impl", "gather_impl", "lane_pad",
               "block_b", "impl", "firms", "epochs")
 
@@ -53,9 +56,14 @@ def row_key(row):
     (absent == None, so a row missing a field never forks a near-
     duplicate key from one carrying it as None). render_table and
     drift_report must agree on this — two rows that the table shows as
-    one measurement line are repeat captures, not different programs."""
+    one measurement line are repeat captures, not different programs.
+    Exception: an absent ``dtype`` normalizes to ``"f32"`` — every row
+    captured before the precision stamp (PR 9) ran the f32 lane, and a
+    fresh f32 capture must continue that trajectory, not fork it."""
     return (row.get("metric"),) + tuple(
-        (k, row.get(k)) for k in KEY_FIELDS)
+        (k, row.get(k) if not (k == "dtype" and row.get(k) is None)
+         else "f32")
+        for k in KEY_FIELDS)
 
 
 def load_rows(path):

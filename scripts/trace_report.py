@@ -352,10 +352,16 @@ def print_report(rep: Dict[str, Any]) -> None:
               f"×{m.get('devices')}  jax={m.get('jax')}  "
               f"git={m.get('git_sha')}  at {m.get('ts')}")
         knobs = m.get("knobs") or {}
-        on = [k for k, v in knobs.items() if v]
+        # Bool knobs render as on/off; VALUED knobs (e.g. the precision
+        # lane "f32"/"bf16") render as name=value — "on=precision" for
+        # an f32 run would be nonsense.
+        on = [k for k, v in knobs.items() if v is True]
         off = [k for k, v in knobs.items() if v is False]
+        valued = [f"{k}={v}" for k, v in knobs.items()
+                  if v is not None and not isinstance(v, bool)]
         print(f"knobs       : on={','.join(on) or '-'}  "
-              f"off={','.join(off) or '-'}")
+              f"off={','.join(off) or '-'}"
+              + (f"  {' '.join(valued)}" if valued else ""))
     tf = rep.get("trace_files") or []
     print(f"trace files : "
           f"{', '.join(tf) + ' (load at ui.perfetto.dev)' if tf else 'MISSING (run still in flight or crashed?)'}")

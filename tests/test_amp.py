@@ -1,0 +1,319 @@
+"""Mixed-precision lane (LFM_PRECISION, DESIGN.md §17): the `amp` lane.
+
+The lane's contract, each half measured rather than asserted:
+
+* **Knob routing** — ``RunConfig.precision`` wins over the
+  ``LFM_PRECISION`` env, default f32, invalid values fail loudly, and
+  the resolved lane lands in the telemetry manifest's probed knobs.
+* **Cast boundaries** — bf16 model compute + bf16 resident panel with
+  f32 MASTER params, f32 Adam moments and an f32 head/loss/IC boundary:
+  the dtypes are inspected on the live TrainState/panel before and
+  after real fits (quantized masters would silently stall Adam once
+  updates drop below bf16 resolution).
+* **Decision semantics** — early-stop decisions (best epoch, stop
+  epoch) EXACT vs the f32 fit at equal seeds, val IC within tolerance:
+  reductions and comparisons never ride the bf16 path.
+* **Reuse** — warm bf16 fits pay zero jit traces / zero panel H2D, and
+  a lane flip is a program-cache MISS plus a fresh panel residency
+  entry (never a stale-precision executable or a wrong-dtype panel).
+
+Module name sorts before the tier-1 timebox cut (the cut lands in
+test_ring.py), so this lane always runs. The program-KEY membership
+tests live with the other key-family collision suites in
+tests/test_buckets.py.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import (
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    RunConfig,
+    compute_dtype,
+    resolve_precision,
+)
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.data.windows import clear_panel_cache
+from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.train.loop import Trainer
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+pytestmark = pytest.mark.amp
+
+
+def _cfg(tmp=None, **opt):
+    return RunConfig(
+        name="amp",
+        data=DataConfig(n_firms=100, n_months=200, n_features=5, window=12,
+                        dates_per_batch=4, firms_per_date=32),
+        # A recurrent trunk on purpose: the scan carries bf16 state, the
+        # widest cast surface the lane has.
+        model=ModelConfig(kind="gru", kwargs={"hidden": 8}),
+        optim=OptimConfig(**{"lr": 1e-3, "epochs": 3, "warmup_steps": 5,
+                             "loss": "mse", **opt}),
+        seed=0,
+        out_dir=str(tmp) if tmp else "runs",
+    )
+
+
+@pytest.fixture(scope="module")
+def panel():
+    return synthetic_panel(n_firms=100, n_months=200, n_features=5, seed=5)
+
+
+@pytest.fixture(scope="module")
+def splits(panel):
+    return PanelSplits.by_date(panel, 198001, 198201)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    """Deterministic counter/cache arithmetic per test (the reuse-lane
+    convention): precision flips must start from empty caches or another
+    module's donor entries would blur hit/miss assertions."""
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    yield
+    reuse.clear_program_cache()
+    clear_panel_cache()
+
+
+def _float_leaves(tree):
+    return [x for x in jax.tree.leaves(tree)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
+
+
+# ---- knob routing --------------------------------------------------------
+
+
+def test_knob_routing(monkeypatch):
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    cfg = _cfg()
+    assert resolve_precision() == "f32"
+    assert resolve_precision(cfg) == "f32"
+    assert compute_dtype(cfg) is None
+
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    assert resolve_precision() == "bf16"
+    assert resolve_precision(cfg) == "bf16"
+    assert compute_dtype(cfg) == jnp.bfloat16
+    # Config field WINS over the env (per-run pin beats fleet switch).
+    pinned = dataclasses.replace(cfg, precision="f32")
+    assert resolve_precision(pinned) == "f32"
+    assert compute_dtype(pinned) is None
+
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    assert resolve_precision(dataclasses.replace(cfg, precision="bf16")) \
+        == "bf16"
+    # The per-model bf16 flag still selects bf16 compute on its own.
+    mdl = dataclasses.replace(cfg, model=dataclasses.replace(
+        cfg.model, bf16=True))
+    assert compute_dtype(mdl) == jnp.bfloat16
+
+    monkeypatch.setenv("LFM_PRECISION", "fp16")
+    with pytest.raises(ValueError, match="precision"):
+        resolve_precision()
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    with pytest.raises(ValueError, match="precision"):
+        resolve_precision(dataclasses.replace(cfg, precision="half"))
+
+
+def test_precision_roundtrips_config_json(monkeypatch):
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    cfg = dataclasses.replace(_cfg(), precision="bf16")
+    back = RunConfig.from_json(cfg.to_json())
+    assert back.precision == "bf16"
+    assert resolve_precision(back) == "bf16"
+
+
+def test_manifest_probes_precision(monkeypatch):
+    from lfm_quant_tpu.utils import telemetry
+
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    m = telemetry.build_manifest()
+    assert m["knobs"]["precision"] == "bf16"
+    assert m["env_lfm"].get("LFM_PRECISION") == "bf16"
+
+
+# ---- cast boundaries -----------------------------------------------------
+
+
+def test_master_params_and_moments_stay_f32(splits, tmp_path, monkeypatch):
+    """The core invariant: bf16 COMPUTE (model dtype + resident panel),
+    f32 STATE — params, Adam moments, step. Checked on the fresh init
+    AND after a real fit (an optimizer update must never launder a
+    bf16 cast back into the masters)."""
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    tr = Trainer(_cfg(tmp_path), splits)
+    assert tr._compute_dtype == jnp.bfloat16
+    assert tr.dev["xm"].dtype == jnp.bfloat16
+    # Targets feed the loss and must NOT ride the compute cast.
+    assert tr.dev["targets"].dtype == jnp.float32
+    assert tr.model.dtype == jnp.bfloat16
+    assert tr.eval_model.dtype == jnp.bfloat16
+
+    state = tr.init_state()
+    assert {str(x.dtype) for x in _float_leaves(state.params)} == {"float32"}
+    assert {str(x.dtype) for x in _float_leaves(state.opt_state)} \
+        == {"float32"}
+
+    tr.fit()
+    assert {str(x.dtype) for x in _float_leaves(tr.state.params)} \
+        == {"float32"}
+    assert {str(x.dtype) for x in _float_leaves(tr.state.opt_state)} \
+        == {"float32"}
+    # The f32 head boundary: forecasts and eval metrics come back f32.
+    pred, valid = tr.predict(split="val")
+    assert pred.dtype == np.float32 and valid.any()
+    ev = tr.evaluate(tr.state.params)
+    assert np.isfinite(ev["ic"]) and np.isfinite(ev["mse"])
+
+
+def test_bf16_trunk_actually_computes_in_bf16(splits, monkeypatch):
+    """The lane must not be a no-op: the gathered windows a bf16-lane
+    step consumes are bf16 (half the gather bytes — the panel side),
+    while the same gather under f32 stays f32."""
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    tr = Trainer(_cfg(), splits)
+    b = tr.val_sampler.stacked_cross_sections()
+    x, m = tr._gather(tr.dev["xm"], jnp.asarray(b.firm_idx[:2]),
+                      jnp.asarray(b.time_idx[:2]))
+    assert x.dtype == jnp.bfloat16 and m.dtype == jnp.bool_
+
+
+# ---- decision semantics --------------------------------------------------
+
+
+def test_decisions_exact_vs_f32_at_equal_seeds(splits, tmp_path,
+                                               monkeypatch):
+    """The parity contract bench gates on, pinned in-tier: same seeds,
+    f32 vs bf16 lane — identical epoch count, identical best epoch
+    (early-stop DECISIONS exact; ICs compare in f32 on both lanes), val
+    ICs within the pre-registered tolerance every epoch."""
+    cfg = _cfg(tmp_path, epochs=4, early_stop_patience=2)
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    f32 = Trainer(cfg, splits).fit()
+    reuse.clear_program_cache()
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    b16 = Trainer(cfg, splits).fit()
+
+    assert b16["epochs_run"] == f32["epochs_run"]
+    assert b16["best_epoch"] == f32["best_epoch"]
+    assert abs(b16["best_val_ic"] - f32["best_val_ic"]) <= 0.02
+    ic32 = [h["val_ic"] for h in f32["history"]]
+    ic16 = [h["val_ic"] for h in b16["history"]]
+    assert len(ic16) == len(ic32)
+    np.testing.assert_allclose(ic16, ic32, atol=0.02)
+
+
+# ---- reuse / residency ---------------------------------------------------
+
+
+def test_warm_bf16_fit_zero_traces_zero_h2d(splits, tmp_path, monkeypatch):
+    """The reuse contract with the knob ON: a second same-key bf16
+    trainer binds the first one's executables and bf16 resident panel —
+    zero new jit traces, zero panel H2D."""
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    cfg = _cfg(tmp_path)
+    Trainer(cfg, splits).fit()
+    snap = REUSE_COUNTERS.snapshot()
+    Trainer(cfg, splits).fit()
+    d = REUSE_COUNTERS.delta(snap)
+    assert d["jit_traces"] == 0, d
+    assert d["panel_transfers"] == 0, d
+    assert d["program_cache_hits"] >= 1
+
+
+def test_lane_flip_is_a_cache_miss_never_stale_reuse(splits, tmp_path,
+                                                     monkeypatch):
+    """Flipping LFM_PRECISION mid-process changes the trainer program
+    key (tagged member) and the panel residency key (dtype member):
+    fresh programs, fresh bf16 panel transfer — the f32 executables and
+    f32 panel are never served to the bf16 lane or vice versa."""
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    cfg = _cfg(tmp_path)
+    t32 = Trainer(cfg, splits)
+    t32.fit()
+    snap = REUSE_COUNTERS.snapshot()
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    t16 = Trainer(cfg, splits)
+    t16.fit()
+    d = REUSE_COUNTERS.delta(snap)
+    assert t16.program_key != t32.program_key
+    assert ("precision", "bf16") in t16.program_key
+    assert ("precision", "f32") in t32.program_key
+    assert d["program_cache_misses"] >= 1
+    assert d["jit_traces"] > 0          # really recompiled
+    assert d["panel_transfers"] == 1    # a NEW bf16 residency entry
+    assert t16.dev["xm"].dtype == jnp.bfloat16
+    assert t32.dev["xm"].dtype == jnp.float32
+
+
+# ---- bench rows / knob tooling ------------------------------------------
+
+
+def test_bench_rows_record_dtype_and_backend(tmp_path, monkeypatch):
+    """Satellite: every BENCH_ROWS.jsonl row carries the compute
+    precision and backend, so mixed-precision rows are distinguishable
+    from the f32 CPU-fallback trajectory."""
+    import bench as bench_mod
+
+    rows = tmp_path / "rows.jsonl"
+    monkeypatch.setenv("LFM_BENCH_ROWS", str(rows))
+    monkeypatch.delenv("LFM_BENCH_NO_PERSIST", raising=False)
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    bench_mod._emit("amp_probe_metric", 1.0, 0.0)
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    bench_mod._emit("amp_probe_metric", 2.0, 0.0)
+    bench_mod._emit_status("ok", persist=True)
+    recs = [json.loads(ln) for ln in rows.read_text().splitlines()]
+    assert [r["dtype"] for r in recs] == ["f32", "bf16", "bf16"]
+    assert recs[0]["backend"] == "cpu"
+    assert all("dtype" in r for r in recs)
+
+
+def _load_check_knobs():
+    path = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                        "check_knobs.py")
+    spec = importlib.util.spec_from_file_location("check_knobs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_knobs_repo_is_clean():
+    """Satellite: the static LFM_* knob cross-check passes on the repo
+    as committed — every env read documented, every manifest probe
+    resolvable. A new undocumented knob fails HERE, inside tier-1."""
+    ck = _load_check_knobs()
+    assert ck.check() == []
+    # And the checker itself sees the lane's knob + probe.
+    assert "LFM_PRECISION" in ck.env_reads()
+    assert "LFM_PRECISION" in ck.documented_knobs()
+    assert any(n == "precision" for n, _, _ in ck.manifest_probes())
+
+
+def test_check_knobs_flags_undocumented_reads(tmp_path):
+    """The checker actually detects: a fabricated mini-repo with one
+    undocumented read fails, and documenting it clears the failure."""
+    ck = _load_check_knobs()
+    pkg = tmp_path / "lfm_quant_tpu" / "utils"
+    pkg.mkdir(parents=True)
+    (pkg / "telemetry.py").write_text("_KNOB_PROBES = ()\n")
+    (tmp_path / "mod.py").write_text(
+        'import os\nX = os.environ.get("LFM_SHINY_NEW", "0")\n')
+    (tmp_path / "README.md").write_text("no knobs here\n")
+    probs = ck.check(str(tmp_path))
+    assert len(probs) == 1 and "LFM_SHINY_NEW" in probs[0]
+    (tmp_path / "README.md").write_text("`LFM_SHINY_NEW` does things\n")
+    assert ck.check(str(tmp_path)) == []

@@ -141,6 +141,57 @@ def test_stacked_program_key_families_collision_free():
     assert {"foldstack", "stacked", "serve", "ensemble"} <= tags
 
 
+@pytest.mark.amp
+def test_precision_key_membership_all_families_collision_free(monkeypatch):
+    """The compute-precision lane (LFM_PRECISION / RunConfig.precision,
+    DESIGN.md §17) is a tagged member of the TRAINER program key — and
+    because every other family (ensemble / foldstack / stacked / serve /
+    trainbucket) embeds that inner key, the lane is a member of ALL SIX
+    families: the same geometry under f32 vs bf16 yields twelve distinct
+    keys, collision-free across lanes and families alike."""
+    from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
+                                      RunConfig)
+
+    cfg = RunConfig(name="k", data=DataConfig(), model=ModelConfig(),
+                    optim=OptimConfig())
+
+    def trainer_key():
+        return reuse.trainer_program_key(cfg, None, 1, "xla", "xla",
+                                         "xla", 6, 10)
+
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    k32 = trainer_key()
+    monkeypatch.setenv("LFM_PRECISION", "bf16")
+    k16 = trainer_key()
+    assert ("precision", "f32") in k32
+    assert ("precision", "bf16") in k16
+    assert k32 != k16
+    # The config field routes into the key too (env deleted).
+    monkeypatch.delenv("LFM_PRECISION", raising=False)
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, precision="bf16")
+    assert trainer_key() == k16
+
+    def family(inner):
+        return [
+            inner,
+            reuse.ensemble_program_key(inner, None, 4, 0),
+            reuse.foldstack_program_key(inner, None, 4, 5),
+            reuse.stacked_program_key(inner, None, 4, 5, "config",
+                                      ("lr", "weight_decay")),
+            reuse.serve_program_key(inner, (8, 64)),
+            reuse.train_bucket_program_key(inner, (8, 64)),
+        ]
+
+    keys = family(k32) + family(k16)
+    assert len(set(keys)) == 12, keys
+    # Equal-but-for-precision pairs differ ONLY through the inner key —
+    # proving membership in every derived family, not just the trainer's.
+    for a, b in zip(family(k32), family(k16)):
+        assert a != b
+
+
 def test_serve_knob_defaults(monkeypatch):
     for var in ("LFM_SERVE_MAX_ROWS", "LFM_SERVE_MAX_WAIT_MS",
                 "LFM_SERVE_ZOO"):

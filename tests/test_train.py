@@ -728,13 +728,12 @@ def test_measure_eval_counts_real_firm_months(panel, tmp_path, monkeypatch):
     cfg = tiny_cfg(out_dir=str(tmp_path))
     dates = panel.dates
     splits = PanelSplits.by_date(panel, int(dates[100]), int(dates[120]))
-    # The frozen clock's tick parity requires measure_eval's timed
-    # region to read the clock EXACTLY twice (t0, end). With telemetry
-    # on, the _LedgeredJit wrapper reads it once more per dispatch
-    # (train/reuse.py — the compile-cost stopwatch), which lands dt on
-    # the same tick value and divides by zero. Pin it off: this test
-    # pins the firm-month ARITHMETIC, not the ledger.
-    monkeypatch.setenv("LFM_TELEMETRY", "0")
+    # Telemetry stays at its default (ON): the ledger stopwatch now
+    # reads the clock ONLY on calls that traced (an even number of
+    # reads — trace-start stamp + post-call read), so warm dispatches
+    # inside the timed region preserve the frozen clock's tick parity
+    # and dt can never collapse to zero. This test doubles as the
+    # regression guard for that fix (it used to need LFM_TELEMETRY=0).
 
     def frozen_clock():
         # Each measured interval reads the clock twice: t0 then t0+2.
