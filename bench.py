@@ -1338,6 +1338,190 @@ def bench_serve() -> None:
     _emit("serve", med, 0.0, **extras)
 
 
+def bench_serve_degradation() -> None:
+    """serve_degradation — the chaos-hardened serving metric: what the
+    graceful-degradation layer (DESIGN.md §18) buys when the service is
+    pushed past capacity and when the dispatch path faults.
+
+    Two priced properties, one row:
+
+    * OVERLOAD — drive ~2× the service's measured closed-loop capacity
+      (open-loop, paced submits) for a fixed window with bounded
+      admission ON (small LFM_SERVE_QUEUE_MAX) vs OFF (unbounded
+      queue). Shedding on: the excess is refused in O(1) (the 429 path)
+      and the p99 of ADMITTED requests stays bounded by queue_max ×
+      service time; shedding off: everything is admitted and queue
+      delay pushes p99 toward the whole window length. The row's
+      primary value is goodput (completed requests/sec) with shedding
+      on, median-of-reps; the shed-off p99 ratio is the comparison
+      column.
+    * RECOVERY — inject a deterministic burst of transient dispatch
+      faults (utils/faults.py, the serve_dispatch site) under repeated
+      scoring and measure wall time from the first fault to the next
+      successful response — the bounded-retry path. Gated before
+      recording: the recovered response is BIT-EQUAL to the fault-free
+      score and the whole chaos episode pays zero steady-state jit
+      traces and zero panel H2D (failures must not recompile anything).
+
+    Toy universes on purpose (the metric prices the degradation
+    machinery, not model FLOPs — c2/c5 own throughput, serve owns the
+    healthy path). CPU fallback per the wedged-tunnel protocol;
+    median-of-3 per BASELINE.md."""
+    import time as _time
+
+    import numpy as np
+
+    import serve as serve_mod
+    from lfm_quant_tpu.serve import ScoringService
+    from lfm_quant_tpu.serve.errors import ServeError
+    from lfm_quant_tpu.serve.stats import percentile
+    from lfm_quant_tpu.utils import faults
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    reps = max(1, int(os.environ.get("LFM_BENCH_OUTER_REPS", "3")))
+    window_s = float(os.environ.get("LFM_BENCH_DEGRADE_WINDOW_S", "2.0"))
+    rtt = dispatch_rtt_ms()  # covariate BEFORE measuring (contract)
+    universes = serve_mod.build_universes(2, train_epochs=0)
+
+    def overload_pass(queue_max: int):
+        """One 2×-overload window against a fresh service with the
+        given admission bound (0 = unbounded). Returns the goodput/p99/
+        shed rollup of the window. ``max_rows=1`` on purpose: with
+        coalescing on, the closed-loop capacity probe under-reads the
+        open-loop ceiling (batching absorbs the "overload") — one row
+        per dispatch makes the probe the true service rate, so 2× it is
+        a genuine overload."""
+        svc = ScoringService(max_rows=1, max_wait_ms=0.0,
+                             queue_max=queue_max, retries=0,
+                             breaker_threshold=0, deadline_ms=0)
+        try:
+            for name, (trainer, _) in universes.items():
+                svc.register(name, trainer)
+            names = svc.zoo.universes()
+            months = {u: svc.serveable_months(u) for u in names}
+            # Capacity probe: short closed-loop drive (the serve row's
+            # own load pattern) — the overload target is 2× this.
+            wall, _, _ = serve_mod.drive_load(svc, 100, 4)
+            capacity = 100 / max(wall, 1e-9)
+            svc.batcher.reset_stats()
+            target_rate = 2.0 * capacity
+            n_target = max(20, int(target_rate * window_s))
+            interval = 1.0 / target_rate
+            futures = []
+            t0 = _time.perf_counter()
+            for k in range(n_target):
+                due = t0 + k * interval
+                lag = due - _time.perf_counter()
+                if lag > 0:
+                    _time.sleep(lag)
+                u = names[k % len(names)]
+                ms = months[u]
+                futures.append(svc.submit(u, ms[k % len(ms)]))
+            lat, completed, shed = [], 0, 0
+            for f in futures:
+                try:
+                    r = f.result(timeout=120)
+                    lat.append(r.latency_ms)
+                    completed += 1
+                except ServeError:
+                    shed += 1
+                except Exception:  # noqa: BLE001 — counted, not fatal
+                    shed += 1
+            wall2 = _time.perf_counter() - t0
+            return {
+                "offered": n_target,
+                "offered_per_sec": round(n_target / wall2, 1),
+                "capacity_probe_per_sec": round(capacity, 1),
+                "goodput_per_sec": round(completed / wall2, 1),
+                "completed": completed,
+                "shed": shed,
+                "shed_frac": round(shed / n_target, 4),
+                "p50_ms": percentile(lat, 50.0),
+                "p99_ms": percentile(lat, 99.0),
+            }
+        finally:
+            svc.close()
+
+    def recovery_pass():
+        """One transient-fault episode: every dispatch fails (injected)
+        until the fault budget drains; measure first-fault → first
+        success and gate on bit-equal scores + zero recompiles."""
+        # Knobs PINNED (not env defaults): the 4-fault budget's
+        # "deterministic schedule" below assumes exactly 2 retries per
+        # dispatch and no breaker — ambient LFM_SERVE_RETRIES /
+        # LFM_SERVE_BREAKER must not silently change what this row
+        # measures.
+        svc = ScoringService(max_rows=4, max_wait_ms=0.5, queue_max=0,
+                             retries=2, breaker_threshold=0)
+        try:
+            name, (trainer, _) = next(iter(universes.items()))
+            svc.register(name, trainer)
+            m = svc.serveable_months(name)[5]
+            ref = svc.score(name, m).scores.copy()
+            snap = REUSE_COUNTERS.snapshot()
+            # retries default 2 → 3 attempts per dispatch; a 4-fault
+            # budget fails the first score outright and recovers the
+            # second via one retry — deterministic schedule.
+            faults.configure("serve_dispatch:n=4,kind=transient")
+            t0 = _time.perf_counter()
+            recovered_ms = None
+            incorrect = failures = 0
+            deadline = t0 + 30.0
+            while _time.perf_counter() < deadline:
+                try:
+                    r = svc.score(name, m, timeout=10)
+                except Exception:  # noqa: BLE001 — the injected outage
+                    failures += 1
+                    continue
+                if not np.array_equal(r.scores, ref):
+                    incorrect += 1
+                recovered_ms = (_time.perf_counter() - t0) * 1e3
+                break
+            faults.configure("")
+            d = REUSE_COUNTERS.delta(snap)
+            stats = svc.batcher.stats()
+            return {
+                "recovery_ms": (round(recovered_ms, 1)
+                                if recovered_ms is not None else None),
+                "failed_scores": failures,
+                "incorrect_responses": incorrect,
+                "retries": stats.get("retries", 0),
+                "compiles_steady_state": d.get("jit_traces", 0),
+                "panel_h2d_steady_state": d.get("panel_transfers", 0),
+            }
+        finally:
+            faults.configure("")
+            svc.close()
+
+    on_reps = sorted((overload_pass(queue_max=32) for _ in range(reps)),
+                     key=lambda r: r["goodput_per_sec"])
+    shed_on = on_reps[len(on_reps) // 2]
+    shed_off = overload_pass(queue_max=0)
+    rec_reps = sorted((recovery_pass() for _ in range(reps)),
+                      key=lambda r: r["recovery_ms"] or float("inf"))
+    rec = rec_reps[len(rec_reps) // 2]
+    extras = {
+        "unit": "goodput requests/sec under 2x overload (shed on)",
+        "queue_max_on": 32,
+        "window_s": window_s,
+        "n_reps": reps,
+        "rep_values": [r["goodput_per_sec"] for r in on_reps],
+        "shed_on": shed_on,
+        "shed_off": shed_off,
+        # The headline comparison: bounded admission keeps the admitted
+        # tail bounded while the unbounded queue's p99 grows toward the
+        # window length.
+        "p99_ratio_off_over_on": (
+            round(shed_off["p99_ms"] / shed_on["p99_ms"], 2)
+            if shed_on.get("p99_ms") and shed_off.get("p99_ms") else None),
+        "recovery": rec,
+        "recovery_rep_ms": [r["recovery_ms"] for r in rec_reps],
+    }
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("serve_degradation", shed_on["goodput_per_sec"], 0.0, **extras)
+
+
 def bench_epoch_pipeline() -> None:
     """epoch_pipeline — the async training-loop metric: epochs/hour on a
     CHECKPOINT-ENABLED multi-epoch fit with the one-epoch-lookahead
@@ -1801,7 +1985,8 @@ def main() -> int:
                 for flag in ("--walkforward-reuse", "--walkforward-foldstack",
                              "--config-sweep", "--bucketed-train",
                              "--mixed-precision", "--scoring-pipeline",
-                             "--epoch-pipeline", "--serve"):
+                             "--epoch-pipeline", "--serve",
+                             "--serve-degradation"):
                     _cpu_metric_fallback(
                         flag,
                         deadline_s - (time.monotonic() - t_start) - 30.0)
@@ -1893,6 +2078,14 @@ def main() -> int:
             _emit_status("bench_error", stage="serve",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
+        try:
+            bench_serve_degradation()
+        except Exception as e:  # noqa: BLE001 — earlier rows must still reach the driver
+            print(f"bench_serve_degradation failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            _emit_status("bench_error", stage="serve_degradation",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
         return 0
     except Exception as e:  # noqa: BLE001 — NO exit path may skip the record
         _emit_status("bench_error", stage="harness",
@@ -1941,6 +2134,9 @@ if __name__ == "__main__":
     if "--epoch-pipeline" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_epoch_pipeline,
                                      "epoch_pipeline"))
+    if "--serve-degradation" in sys.argv[1:]:
+        sys.exit(_single_metric_main(bench_serve_degradation,
+                                     "serve_degradation"))
     if "--serve" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_serve, "serve"))
     sys.exit(main())

@@ -694,8 +694,14 @@ def device_panel(panel: Panel, sharding=None, compute_dtype=None,
     ``panel.n_features + 1`` (callers pass it as ``fp``); phantom months
     carry validity 0.
     """
+    from lfm_quant_tpu.utils import faults
     from lfm_quant_tpu.utils.telemetry import COUNTERS
 
+    # Chaos lane (utils/faults.py): the panel transfer is the residency
+    # layer's only H2D — an injectable failure here exercises every
+    # caller's cold-path error handling. Exact no-op when LFM_FAULTS is
+    # unset.
+    faults.check("panel_h2d", n_firms=panel.n_firms, n_months=panel.n_months)
     put = (lambda x: jax.device_put(x, sharding)) if sharding is not None else jnp.asarray
     # Locked bump, not the property view's `+=`: cold transfers of
     # DIFFERENT panels can now run concurrently (the residency cache

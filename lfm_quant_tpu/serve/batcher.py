@@ -13,28 +13,78 @@ traces (every bucket was warmed), zero panel H2D (the panel is
 resident), and one small H2D (int32 indices + f32 weights) + one D2H
 (f32 scores) per BATCH.
 
+Graceful degradation (DESIGN.md §18 — the chaos-hardened layer; every
+path below is drivable on demand via the ``serve_dispatch``/``zoo_lease``
+fault sites in utils/faults.py):
+
+* **Bounded admission** — the queue is capped at ``LFM_SERVE_QUEUE_MAX``;
+  a submit over the cap is SHED in O(1) (:class:`ShedError`, HTTP 429)
+  instead of growing an unbounded backlog where every request times out.
+* **Deadlines** — each request carries a deadline (explicit per call,
+  else ``LFM_SERVE_DEADLINE_MS``; ``ScoringService.score`` propagates
+  its client timeout). Expired or client-cancelled requests are dropped
+  BEFORE dispatch (:class:`DeadlineError`, HTTP 504) — a client that
+  gave up at 60 s no longer costs a device dispatch.
+* **Bounded jittered retry** — a TRANSIENT dispatch failure
+  (serve/errors.py ``is_transient``) re-dispatches the surviving batch
+  up to ``LFM_SERVE_RETRIES`` times with capped exponential backoff;
+  deadlines are re-checked before every retry.
+* **Circuit breaker** — ``LFM_SERVE_BREAKER`` consecutive exhausted
+  dispatch failures OPEN the circuit: submits fast-fail
+  (:class:`CircuitOpenError`, HTTP 503 + retry-after) for
+  ``LFM_SERVE_BREAKER_COOLDOWN_MS``, then a half-open probe admits
+  traffic again — one success closes the circuit, one failure re-opens
+  it. State transitions emit ``circuit_open``/``circuit_half_open``/
+  ``circuit_closed`` instants and the ``circuit_state`` gauge
+  (0 closed / 1 half-open / 2 open).
+* **Thread-death guard** — if the batcher thread dies OUTSIDE the
+  per-batch failure path (e.g. ``_next_batch`` raising), every pending
+  future is failed loudly (:class:`BatcherDeadError`), subsequent
+  submits fail fast, and :meth:`health` reports unready — the pre-chaos
+  behavior was every client hanging until its own timeout.
+
 Observability (PR 4 registry): every request is an async
 ``serve_request`` span begun at submit and ended at completion carrying
 ``latency_ms`` (the number ``stats()``/bench/trace_report all roll up —
 one measurement, three consumers, no drift); every dispatch is a sync
 ``serve_batch`` span carrying rows/occupancy/queue depth; counters
 ``serve_requests`` / ``serve_batches`` / ``serve_rows`` /
-``serve_rows_real`` / ``serve_queue_peak`` feed the run record.
+``serve_rows_real`` / ``serve_queue_peak`` plus the degradation set
+``serve_shed`` / ``serve_deadline_drops`` / ``serve_retries`` /
+``serve_breaker_opens`` / ``circuit_state`` feed the run record
+(rendered by ``scripts/trace_report.py``'s serve section).
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
+import warnings
 from collections import deque
 from concurrent.futures import Future
-from typing import Any, Dict, List, NamedTuple, Optional
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from lfm_quant_tpu.serve.buckets import bucket_rows, bucket_width
+from lfm_quant_tpu.serve.buckets import (
+    breaker_cooldown_ms_default,
+    breaker_threshold_default,
+    bucket_rows,
+    bucket_width,
+    deadline_ms_default,
+    queue_max_default,
+    retries_default,
+)
+from lfm_quant_tpu.serve.errors import (
+    BatcherDeadError,
+    CircuitOpenError,
+    DeadlineError,
+    ShedError,
+    is_transient,
+)
 from lfm_quant_tpu.serve.zoo import ModelZoo
-from lfm_quant_tpu.utils import telemetry
+from lfm_quant_tpu.utils import faults, telemetry
 
 
 class ScoreResponse(NamedTuple):
@@ -56,29 +106,56 @@ class ScoreResponse(NamedTuple):
 
 class _Request:
     __slots__ = ("universe", "month", "width", "future", "t_submit",
-                 "span")
+                 "span", "deadline")
 
     def __init__(self, universe: str, month: int, width: int,
-                 future: Future, span):
+                 future: Future, span, deadline: Optional[float]):
         self.universe = universe
         self.month = month
         self.width = width
         self.future = future
         self.t_submit = time.perf_counter()
         self.span = span
+        self.deadline = deadline  # absolute perf_counter seconds, or None
 
 
 class MicroBatcher:
     """The queue + batcher thread. One instance per ScoringService."""
 
     def __init__(self, zoo: ModelZoo, max_rows: int, max_wait_ms: float,
-                 latency_window: int = 65536):
+                 latency_window: int = 65536,
+                 queue_max: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_cooldown_ms: Optional[float] = None):
         self.zoo = zoo
         self.max_rows = max(1, int(max_rows))
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        # Degradation knobs: explicit ctor values win (tests/bench),
+        # else the LFM_SERVE_* env defaults (serve/buckets.py).
+        self.queue_max = int(queue_max if queue_max is not None
+                             else queue_max_default())
+        self.default_deadline_s = float(
+            deadline_ms if deadline_ms is not None
+            else deadline_ms_default()) / 1e3
+        self.retries = max(0, int(retries if retries is not None
+                                  else retries_default()))
+        self._breaker_threshold = int(
+            breaker_threshold if breaker_threshold is not None
+            else breaker_threshold_default())
+        self._breaker_cooldown_s = max(0.0, float(
+            breaker_cooldown_ms if breaker_cooldown_ms is not None
+            else breaker_cooldown_ms_default())) / 1e3
         self._queue: "deque[_Request]" = deque()
         self._cv = threading.Condition()
         self._stop = False
+        # Breaker / death state (guarded by _cv; _dead is also read
+        # lock-free on the submit fast path — a benign GIL-atomic read).
+        self._circuit = "closed"  # closed | half_open | open
+        self._fail_streak = 0
+        self._open_until = 0.0
+        self._dead: Optional[BaseException] = None
         self._stats_lock = threading.Lock()
         self._lat_ms: "deque[float]" = deque(maxlen=max(1, latency_window))
         self._rows = 0
@@ -88,18 +165,42 @@ class MicroBatcher:
         self._errors = 0
         self._rejects = 0
         self._queue_peak = 0
+        self._shed = 0
+        self._deadline_drops = 0
+        self._retry_count = 0
+        self._breaker_opens = 0
         self._thread = threading.Thread(target=self._loop,
                                         name="serve-batcher", daemon=True)
         self._thread.start()
 
     # ---- client side -------------------------------------------------
 
-    def submit(self, universe: str, month: int) -> Future:
+    def submit(self, universe: str, month: int,
+               deadline_ms: Optional[float] = None) -> Future:
         """Enqueue one scoring query; the Future resolves to a
-        :class:`ScoreResponse` (or raises the routing/validation error).
-        Validation that only needs the ROUTING table happens here so a
-        bad request fails fast without occupying the batcher."""
+        :class:`ScoreResponse` (or raises the routing/validation/
+        degradation error). Validation that only needs the ROUTING
+        table happens here so a bad request fails fast without
+        occupying the batcher; admission control (dead batcher, open
+        circuit, full queue) fails fast the same way. ``deadline_ms``
+        (else ``LFM_SERVE_DEADLINE_MS``; 0/None = none) bounds how long
+        the request may wait — past it the batcher DROPS it before
+        dispatch."""
         future: Future = Future()
+        dead = self._dead
+        if dead is not None:
+            future.set_exception(BatcherDeadError(dead))
+            return future
+        now = time.perf_counter()
+        with self._cv:
+            state, ticked = self._circuit_tick_locked(now)
+            open_until = self._open_until
+        if ticked:
+            self._emit_half_open()
+        if state == "open":
+            telemetry.COUNTERS.bump("serve_circuit_rejects")
+            future.set_exception(CircuitOpenError(open_until - now))
+            return future
         try:
             entry = self.zoo.current(universe)  # KeyError → unregistered
             t = entry.month_col(month)
@@ -108,19 +209,38 @@ class MicroBatcher:
         except Exception as e:  # noqa: BLE001 — routed to the caller
             future.set_exception(e)
             return future
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_s * 1e3
+        deadline = (now + deadline_ms / 1e3
+                    if deadline_ms and deadline_ms > 0 else None)
         span = telemetry.begin_async("serve_request", cat="serve",
                                      universe=universe, month=int(month),
                                      n_firms=int(n_firms))
-        req = _Request(universe, int(month), width, future, span)
+        req = _Request(universe, int(month), width, future, span, deadline)
+        shed = False
         with self._cv:
+            if self._dead is not None:
+                span.end(error="unready")
+                future.set_exception(BatcherDeadError(self._dead))
+                return future
             if self._stop:
                 span.end(error="closed")
                 future.set_exception(
                     RuntimeError("scoring service is closed"))
                 return future
-            self._queue.append(req)
-            depth = len(self._queue)
-            self._cv.notify()
+            if 0 < self.queue_max <= len(self._queue):
+                shed = True
+            else:
+                self._queue.append(req)
+                depth = len(self._queue)
+                self._cv.notify()
+        if shed:
+            span.end(error="shed")
+            telemetry.COUNTERS.bump("serve_shed")
+            with self._stats_lock:
+                self._shed += 1
+            future.set_exception(ShedError(self.queue_max))
+            return future
         telemetry.COUNTERS.bump("serve_requests")
         telemetry.COUNTERS.peak("serve_queue_peak", depth)
         with self._stats_lock:
@@ -128,22 +248,100 @@ class MicroBatcher:
                 self._queue_peak = depth
         return future
 
+    # ---- circuit breaker ---------------------------------------------
+
+    def _circuit_tick_locked(self, now: float) -> Tuple[str, bool]:
+        """Advance the breaker clock (caller holds ``_cv``): an OPEN
+        circuit whose cooldown elapsed becomes HALF-OPEN — admission
+        resumes and the next dispatch outcome decides. Returns
+        ``(state, transitioned)``; the CALLER emits the transition
+        telemetry after releasing the lock (the zoo.lease convention —
+        an instant's trace write must never run under the admission
+        lock every submit contends on)."""
+        if self._circuit == "open" and now >= self._open_until:
+            self._circuit = "half_open"
+            return "half_open", True
+        return self._circuit, False
+
+    @staticmethod
+    def _emit_half_open() -> None:
+        telemetry.COUNTERS.set("circuit_state", 1)
+        telemetry.instant("circuit_half_open", cat="serve")
+
+    def _dispatch_ok(self) -> None:
+        with self._cv:
+            self._fail_streak = 0
+            reclosed = self._circuit != "closed"
+            self._circuit = "closed"
+        if reclosed:
+            telemetry.COUNTERS.set("circuit_state", 0)
+            telemetry.instant("circuit_closed", cat="serve")
+
+    def _dispatch_fail(self) -> None:
+        """One exhausted dispatch (retries included) failed: advance the
+        streak; at the threshold — or instantly in half-open (the probe
+        failed) — OPEN the circuit for the cooldown."""
+        opened = False
+        with self._cv:
+            self._fail_streak += 1
+            streak = self._fail_streak
+            if self._breaker_threshold > 0 and (
+                    self._circuit == "half_open"
+                    or streak >= self._breaker_threshold):
+                opened = self._circuit != "open"
+                self._circuit = "open"
+                self._open_until = (time.perf_counter()
+                                    + self._breaker_cooldown_s)
+        if opened:
+            telemetry.COUNTERS.set("circuit_state", 2)
+            telemetry.COUNTERS.bump("serve_breaker_opens")
+            telemetry.instant("circuit_open", cat="serve", streak=streak)
+            with self._stats_lock:
+                self._breaker_opens += 1
+
     # ---- batcher thread ----------------------------------------------
 
     def _loop(self) -> None:
-        while True:
-            batch = self._next_batch()
-            if batch is None:
-                return
-            try:
-                self._dispatch(batch)
-            except Exception as e:  # noqa: BLE001 — the loop must survive
-                with self._stats_lock:
-                    self._errors += 1
-                for r in batch:
-                    if not r.future.done():
-                        r.future.set_exception(e)
-                    r.span.end(error=type(e).__name__)
+        try:
+            while True:
+                batch = self._next_batch()
+                if batch is None:
+                    return
+                try:
+                    self._dispatch(batch)
+                except Exception as e:  # noqa: BLE001 — the loop survives
+                    with self._stats_lock:
+                        self._errors += 1
+                    for r in batch:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                        r.span.end(error=type(e).__name__)
+        except BaseException as e:  # noqa: BLE001 — death guard
+            # The loop died OUTSIDE the per-batch failure path (e.g.
+            # _next_batch raising): without this guard every pending and
+            # future submit hangs until client timeout.
+            self._die(e)
+            raise
+
+    def _die(self, exc: BaseException) -> None:
+        """Batcher-thread death: fail pending futures LOUDLY, mark the
+        service unready (submits fast-fail, /healthz goes 503)."""
+        with self._cv:
+            self._dead = exc
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cv.notify_all()
+        telemetry.COUNTERS.set("serve_batcher_dead", 1)
+        telemetry.instant("batcher_died", cat="serve",
+                          error=type(exc).__name__)
+        warnings.warn(
+            f"serve batcher thread died: {type(exc).__name__}: {exc} — "
+            f"failing {len(pending)} pending request(s); the service is "
+            "unready until restarted", RuntimeWarning, stacklevel=2)
+        for r in pending:
+            if not r.future.done():
+                r.future.set_exception(BatcherDeadError(exc))
+            r.span.end(error="batcher_dead")
 
     def _next_batch(self) -> Optional[List[_Request]]:
         """Pop the head request, then coalesce same-(universe, width)
@@ -177,8 +375,67 @@ class MicroBatcher:
             telemetry.COUNTERS.set("serve_queue_depth", len(self._queue))
             return batch
 
+    def _reap(self, batch: List[_Request]) -> List[_Request]:
+        """Drop expired / client-abandoned requests BEFORE they cost a
+        device dispatch (the deadline contract — run again before every
+        retry, since backoff consumes deadline budget too)."""
+        now = time.perf_counter()
+        live: List[_Request] = []
+        dropped = 0
+        for r in batch:
+            if r.future.cancelled():
+                r.span.end(error="abandoned")
+                dropped += 1
+                continue
+            if r.future.done():
+                continue  # already routed (validation failure)
+            if r.deadline is not None and now >= r.deadline:
+                r.span.end(error="deadline")
+                r.future.set_exception(
+                    DeadlineError(r.universe, r.month, now - r.deadline))
+                dropped += 1
+                continue
+            live.append(r)
+        if dropped:
+            telemetry.COUNTERS.bump("serve_deadline_drops", dropped)
+            with self._stats_lock:
+                self._deadline_drops += dropped
+        return live
+
     def _dispatch(self, batch: List[_Request]) -> None:
+        """Dispatch with bounded jittered retry: a TRANSIENT failure
+        (serve/errors.py ``is_transient`` — injected faults and
+        retryable runtime statuses) re-dispatches the SURVIVING batch
+        (deadlines re-checked) up to ``self.retries`` times; permanent
+        failures and exhaustion fail the batch and feed the breaker."""
         universe = batch[0].universe
+        attempt = 0
+        while True:
+            batch = self._reap(batch)
+            if not batch:
+                return
+            try:
+                self._dispatch_once(universe, batch)
+                return
+            except Exception as e:  # noqa: BLE001 — classified below
+                batch = [r for r in batch if not r.future.done()]
+                if (not is_transient(e) or attempt >= self.retries
+                        or self._stop):
+                    self._dispatch_fail()
+                    raise
+                attempt += 1
+                telemetry.COUNTERS.bump("serve_retries")
+                with self._stats_lock:
+                    self._retry_count += 1
+                # Capped exponential backoff with full jitter: bounded at
+                # 50 ms so a retry burst can never stall the batcher past
+                # a deadline's resolution.
+                time.sleep(min(0.05, 0.002 * (2 ** (attempt - 1)))
+                           * (0.5 + random.random()))
+
+    def _dispatch_once(self, universe: str, batch: List[_Request]) -> None:
+        faults.check("serve_dispatch", universe=universe,
+                     rows=len(batch))
         with self.zoo.lease(universe) as entry:
             # Per-request validation against the LEASED entry: a request
             # validated at submit against an older generation can be
@@ -233,6 +490,10 @@ class MicroBatcher:
                 with entry.lease_panel() as dev:
                     programs = entry.programs_for((rows, width))
                     out = np.asarray(programs(entry.params, dev, fi, ti, w))
+            # Success bookkeeping BEFORE the futures resolve: a client
+            # woken by its result must observe the breaker already
+            # reset/closed (health() right after a successful probe).
+            self._dispatch_ok()
             t_done = time.perf_counter()
             gen = entry.generation
         lats = []
@@ -255,7 +516,34 @@ class MicroBatcher:
             self._batches += 1
             self._requests += len(batch)
 
-    # ---- stats / lifecycle -------------------------------------------
+    # ---- stats / health / lifecycle ----------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        """Readiness, with the reason when degraded: a dead batcher
+        thread or an OPEN circuit is NOT ready (the /healthz 503 path);
+        half-open is ready-but-probing. ``retry_after_s`` carries the
+        remaining cooldown when open."""
+        dead = self._dead
+        if dead is not None:
+            return {"ok": False, "circuit": "dead",
+                    "reason": ("batcher thread dead: "
+                               f"{type(dead).__name__}: {dead}")}
+        now = time.perf_counter()
+        with self._cv:
+            if self._stop:
+                return {"ok": False, "circuit": self._circuit,
+                        "reason": "service closed"}
+            state, ticked = self._circuit_tick_locked(now)
+            retry = max(0.0, self._open_until - now)
+        if ticked:
+            self._emit_half_open()
+        if state == "open":
+            return {"ok": False, "circuit": state,
+                    "reason": ("circuit open (consecutive dispatch "
+                               "failures); fast-failing until the "
+                               "half-open probe"),
+                    "retry_after_s": round(retry, 3)}
+        return {"ok": True, "circuit": state}
 
     def stats(self) -> Dict[str, Any]:
         from lfm_quant_tpu.serve.stats import latency_summary
@@ -268,11 +556,17 @@ class MicroBatcher:
                 "batches": self._batches,
                 "dispatch_errors": self._errors,
                 "rejected": self._rejects,
+                "shed": self._shed,
+                "deadline_drops": self._deadline_drops,
+                "retries": self._retry_count,
+                "breaker_opens": self._breaker_opens,
                 # THIS batcher's peak (the process-global
                 # serve_queue_peak counter spans every instance and is
                 # never reset — it feeds the run record, not stats).
                 "queue_peak": self._queue_peak,
             }
+        out["circuit"] = ("dead" if self._dead is not None
+                          else self._circuit)
         out.update(latency_summary(lat))
         # The rolling window bounds memory on long-lived services; past
         # its size the percentiles cover only the newest requests while
@@ -285,16 +579,19 @@ class MicroBatcher:
         return out
 
     def reset_stats(self) -> None:
-        """Zero the rolling stats window (latencies, occupancy, peaks) —
-        bench draws the line between warmup and the measured steady
-        state with this, so the reported percentiles cover exactly the
-        timed window."""
+        """Zero the rolling stats window (latencies, occupancy, peaks,
+        degradation tallies) — bench draws the line between warmup and
+        the measured steady state with this, so the reported
+        percentiles cover exactly the timed window. Circuit STATE is
+        not reset — it is live machinery, not a statistic."""
         with self._stats_lock:
             self._lat_ms.clear()
             self._rows = self._rows_real = 0
             self._batches = self._requests = 0
             self._errors = self._rejects = 0
             self._queue_peak = 0
+            self._shed = self._deadline_drops = 0
+            self._retry_count = self._breaker_opens = 0
 
     def close(self) -> None:
         """Stop the batcher thread; drain the queue by failing pending

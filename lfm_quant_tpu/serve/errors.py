@@ -1,0 +1,119 @@
+"""Serving failure taxonomy: one vocabulary for shed / deadline /
+breaker / dead-batcher outcomes, shared by the micro-batcher (which
+raises them), the HTTP front door (which maps them to status codes —
+the failure-semantics table in README.md) and the chaos lane (which
+asserts on them).
+
+The transient-vs-permanent split is the retry layer's routing decision:
+:func:`is_transient` answers "is a retry of the same dispatch worth
+anything?" — injected :class:`~lfm_quant_tpu.utils.faults.TransientFault`
+and the runtime's retryable status strings say yes; everything else
+(routing KeyErrors, shape bugs, injected permanent faults) fails fast
+and feeds the circuit breaker instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ServeError(RuntimeError):
+    """Base of the serving-degradation failures. ``http_status`` is the
+    front door's mapping; ``retry_after_s`` (when set) becomes the
+    HTTP ``Retry-After`` hint."""
+
+    http_status = 500
+    retry_after_s: Optional[float] = None
+
+
+class ShedError(ServeError):
+    """Bounded admission refused the request: the queue is at
+    ``LFM_SERVE_QUEUE_MAX``. Shedding is O(1) and intentional — the
+    alternative is unbounded queue growth where EVERY request times out
+    instead of most succeeding. HTTP 429."""
+
+    http_status = 429
+    retry_after_s = 0.1
+
+    def __init__(self, queue_max: int):
+        super().__init__(
+            f"request shed: serving queue full ({queue_max} queued, "
+            "LFM_SERVE_QUEUE_MAX) — retry after backoff")
+        self.queue_max = queue_max
+
+
+class DeadlineError(ServeError):
+    """The request's deadline expired before dispatch — the batcher
+    dropped it instead of spending a device dispatch on an answer
+    nobody is waiting for. HTTP 504."""
+
+    http_status = 504
+
+    def __init__(self, universe: str, month: int, overdue_s: float):
+        super().__init__(
+            f"deadline expired {overdue_s * 1e3:.1f} ms before dispatch "
+            f"for {universe!r}/{month} — dropped undispatched")
+        self.universe = universe
+        self.month = month
+
+
+class CircuitOpenError(ServeError):
+    """The circuit breaker is OPEN after consecutive dispatch failures:
+    fast-fail instead of queueing onto a backend that is currently
+    failing everything. HTTP 503 with a Retry-After of the remaining
+    cooldown (after which a half-open probe decides)."""
+
+    http_status = 503
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            "circuit open after consecutive dispatch failures — "
+            f"fast-failing; retry in {retry_after_s:.3f}s "
+            "(half-open probe follows)")
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
+class BatcherDeadError(ServeError):
+    """The batcher thread died outside the per-batch failure path; the
+    service is unready until restarted. Pending and subsequent requests
+    fail fast with the original cause instead of hanging until client
+    timeout. HTTP 503."""
+
+    http_status = 503
+
+    def __init__(self, cause: BaseException):
+        super().__init__(
+            "scoring service unready: batcher thread died "
+            f"({type(cause).__name__}: {cause})")
+        self.cause = cause
+
+
+#: Runtime status substrings worth a bounded retry (XLA/PJRT transient
+#: status codes surface as RuntimeError text on this jax version).
+_TRANSIENT_TOKENS = ("RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED",
+                     "UNAVAILABLE", "ABORTED")
+
+
+def is_transient(exc: BaseException) -> bool:
+    """The retry layer's classification: True when re-dispatching the
+    same batch has a chance (injected transient faults, retryable
+    runtime statuses); False for everything else — permanent faults,
+    routing errors, genuine bugs — which fail fast and count toward
+    the circuit breaker."""
+    if getattr(exc, "transient", False):
+        return True
+    if isinstance(exc, (KeyError, ValueError, TypeError)):
+        return False
+    msg = str(exc)
+    return any(tok in msg for tok in _TRANSIENT_TOKENS)
+
+
+def http_status(exc: BaseException) -> int:
+    """Exception → HTTP status for the serve.py front door: shed → 429,
+    open circuit / dead batcher → 503, expired deadline → 504, unknown
+    universe/month → 404, anything else → 500."""
+    if isinstance(exc, ServeError):
+        return exc.http_status
+    if isinstance(exc, KeyError):
+        return 404
+    return 500

@@ -41,13 +41,21 @@ class ScoringService:
 
     def __init__(self, zoo_capacity: Optional[int] = None,
                  max_rows: Optional[int] = None,
-                 max_wait_ms: Optional[float] = None):
+                 max_wait_ms: Optional[float] = None,
+                 queue_max: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 breaker_threshold: Optional[int] = None,
+                 breaker_cooldown_ms: Optional[float] = None):
         self.zoo = ModelZoo(zoo_capacity or buckets.zoo_capacity_default())
         self.max_rows = max_rows or buckets.max_rows_default()
         self.batcher = MicroBatcher(
             self.zoo, self.max_rows,
             buckets.max_wait_ms_default() if max_wait_ms is None
-            else max_wait_ms)
+            else max_wait_ms,
+            queue_max=queue_max, deadline_ms=deadline_ms, retries=retries,
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown_ms=breaker_cooldown_ms)
         self._refresh_lock = threading.Lock()
 
     # ---- registration / warmup --------------------------------------
@@ -102,14 +110,23 @@ class ScoringService:
 
     # ---- query path --------------------------------------------------
 
-    def submit(self, universe: str, month: int) -> Future:
-        """Async query: Future of a :class:`ScoreResponse`."""
-        return self.batcher.submit(universe, month)
+    def submit(self, universe: str, month: int,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Async query: Future of a :class:`ScoreResponse`.
+        ``deadline_ms`` bounds how long the request may wait — past it
+        the batcher drops it BEFORE dispatch (DeadlineError)."""
+        return self.batcher.submit(universe, month, deadline_ms=deadline_ms)
 
     def score(self, universe: str, month: int,
               timeout: Optional[float] = 60.0) -> ScoreResponse:
-        """Sync query: the month's scored cross-section."""
-        return self.batcher.submit(universe, month).result(timeout=timeout)
+        """Sync query: the month's scored cross-section. The client
+        ``timeout`` PROPAGATES into the batcher as the request deadline,
+        so a request this caller has already given up on is dropped
+        instead of costing a device dispatch (DESIGN.md §18)."""
+        return self.batcher.submit(
+            universe, month,
+            deadline_ms=None if timeout is None else timeout * 1e3,
+        ).result(timeout=timeout)
 
     def serveable_months(self, universe: str) -> List[int]:
         return self.zoo.current(universe).serveable_months()
@@ -178,6 +195,16 @@ class ScoringService:
         out["zoo_size"] = len(self.zoo)
         out["zoo_capacity"] = self.zoo.capacity
         return out
+
+    def health(self) -> Dict[str, Any]:
+        """REAL readiness (the /healthz contract, DESIGN.md §18): not
+        ready — with the reason — when the batcher thread is dead or
+        the circuit breaker is open; ``retry_after_s`` carries the
+        remaining breaker cooldown. The pre-chaos endpoint returned a
+        constant ``{"ok": true}`` even with the batcher thread dead."""
+        h = self.batcher.health()
+        h["zoo_size"] = len(self.zoo)
+        return h
 
     def close(self) -> None:
         self.batcher.close()

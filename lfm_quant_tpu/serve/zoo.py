@@ -244,6 +244,12 @@ class ModelZoo:
         stays fully servable for the whole block even if a publish or
         eviction unlinks it concurrently — decommission waits for the
         last lease."""
+        from lfm_quant_tpu.utils import faults
+
+        # Chaos lane: an injectable lease failure (utils/faults.py) —
+        # checked OUTSIDE the zoo lock so the telemetry instant never
+        # emits under it. Exact no-op when LFM_FAULTS is unset.
+        faults.check("zoo_lease", universe=universe)
         with self._lock:
             entry = self._entries.get(universe)
             if entry is None:

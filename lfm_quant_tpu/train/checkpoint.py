@@ -9,11 +9,13 @@ read/write (SURVEY.md §6 "checkpoint/resume" row).
 from __future__ import annotations
 
 import os
+import threading
+import warnings
 from typing import Any, Optional
 
 import orbax.checkpoint as ocp
 
-from lfm_quant_tpu.utils import telemetry
+from lfm_quant_tpu.utils import faults, telemetry
 
 
 def fold_slice(state_dict: Any, idx: int) -> Any:
@@ -55,11 +57,23 @@ class CheckpointManager:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        # The single bounded-wait worker (see :meth:`wait`): a timed-out
+        # wait leaves its thread blocked inside Orbax, and a SECOND
+        # wait()/close() must re-join that same thread — two concurrent
+        # wait_until_finished() calls on one manager race its finalize.
+        self._wait_thread: Optional[threading.Thread] = None
+        self._wait_done = threading.Event()
+        self._wait_err: list = []
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
         """Stage a save of ``state`` at ``step``; ``wait=True`` blocks
         until it is durably committed (the synchronous reference path —
-        ``LFM_ASYNC_CKPT=0`` semantics)."""
+        ``LFM_ASYNC_CKPT=0`` semantics; deliberately UNBOUNDED: sync
+        mode's contract is "durable before proceeding", which a timeout
+        cannot honor). ``ckpt_write`` is a chaos fault site
+        (utils/faults.py) — the kill-mid-epoch preemption test schedules
+        its SIGTERM here."""
+        faults.check("ckpt_write", line=self._line, step=int(step))
         with telemetry.span("ckpt_save", cat="ckpt", line=self._line,
                             step=step, wait=wait):
             self._mgr.save(step, args=ocp.args.StandardSave(state))
@@ -80,9 +94,66 @@ class CheckpointManager:
             step, args=ocp.args.StandardRestore(abstract_state)
         )
 
-    def wait(self):
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until in-flight async saves commit — BOUNDED. Orbax's
+        ``wait_until_finished`` has no timeout, and an async writer
+        wedged on storage used to hang finalize/shutdown forever; the
+        wait now runs on a daemon thread joined for ``timeout_s``
+        (default ``LFM_CKPT_WAIT_S``, 120 s; <= 0 restores the
+        unbounded wait). Returns True when the line is durable; on
+        timeout it warns LOUDLY, bumps the ``ckpt_wait_timeouts``
+        counter and returns False — the save may still commit in the
+        background, but the caller's shutdown path proceeds."""
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("LFM_CKPT_WAIT_S", "120"))
         with telemetry.span("ckpt_wait", cat="ckpt", line=self._line):
-            self._mgr.wait_until_finished()
+            if timeout_s <= 0:
+                self._mgr.wait_until_finished()
+                return True
+            # Reuse a still-running worker from a PREVIOUS timed-out
+            # wait: it is still blocked inside wait_until_finished, and
+            # starting a second concurrent one would race Orbax's
+            # finalize if the wedge clears mid-shutdown.
+            if self._wait_thread is None or not self._wait_thread.is_alive():
+                self._wait_done = threading.Event()
+                self._wait_err = []
+                done, err = self._wait_done, self._wait_err
 
-    def close(self):
-        self._mgr.close()
+                def _wait():
+                    try:
+                        self._mgr.wait_until_finished()
+                    except BaseException as e:  # noqa: BLE001 — re-raised below
+                        err.append(e)
+                    finally:
+                        done.set()
+
+                self._wait_thread = threading.Thread(
+                    target=_wait, daemon=True,
+                    name=f"ckpt-wait-{self._line}")
+                self._wait_thread.start()
+            done, err = self._wait_done, self._wait_err
+            if not done.wait(timeout_s):
+                warnings.warn(
+                    f"checkpoint line {self._line!r}: async save still "
+                    f"unfinished after {timeout_s:.0f}s (LFM_CKPT_WAIT_S) — "
+                    "abandoning the wait so shutdown cannot hang; the save "
+                    "may still commit in the background",
+                    RuntimeWarning, stacklevel=2)
+                telemetry.COUNTERS.bump("ckpt_wait_timeouts")
+                return False
+            if err:
+                raise err[0]
+            return True
+
+    def close(self, timeout_s: Optional[float] = None):
+        """Flush (bounded — see :meth:`wait`) and close. A wedged async
+        save is ABANDONED with a loud warning instead of hanging
+        shutdown forever: Orbax's own ``close`` waits unboundedly, so
+        it only runs once the bounded wait confirmed the line drained."""
+        if self.wait(timeout_s):
+            self._mgr.close()
+        else:
+            warnings.warn(
+                f"checkpoint line {self._line!r}: close() abandoned with a "
+                "save still in flight (see the ckpt_wait warning above)",
+                RuntimeWarning, stacklevel=2)

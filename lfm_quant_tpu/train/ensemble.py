@@ -659,9 +659,17 @@ class EnsembleTrainer:
             history.append(rec)
             return step, val_ic
 
-        state, overrun = pipeline.run_fit_epochs(
-            harness, state, build=build, dispatch=dispatch, finish=finish,
-            timer=timer, checkpointing=self.run_dir is not None)
+        try:
+            state, overrun = pipeline.run_fit_epochs(
+                harness, state, build=build, dispatch=dispatch,
+                finish=finish, timer=timer,
+                checkpointing=self.run_dir is not None)
+        except pipeline.preempt.Preempted:
+            # SIGTERM grace stop: recorded epochs are durable (the
+            # driver flushed the checkpoint lines); flush metrics and
+            # propagate — same contract as the single-seed trainer.
+            logger.close()
+            raise
 
         best = harness.finalize(state._asdict())
         if best is not None:

@@ -299,25 +299,58 @@ class FitHarness:
                 # sidecar records them (the pre-pipeline ordering) — a
                 # crash can then never leave the sidecar claiming a
                 # best/latest that no committed checkpoint backs.
+                # timeout_s=0: this contract is "durable before
+                # proceeding", which a bounded wait cannot honor (the
+                # same carve-out as save(wait=True)).
                 if saved_best:
-                    self.best_mgr.wait()
-                self.latest_mgr.wait()
+                    self.best_mgr.wait(timeout_s=0)
+                self.latest_mgr.wait(timeout_s=0)
             save_progress(self.run_dir, epoch=epoch,
                           best_ic=float(self.best_ic),
                           best_epoch=self.best_epoch,
                           bad_epochs=self.bad_epochs)
         return self.bad_epochs >= self.patience
 
+    def preempt_flush(self) -> None:
+        """SIGTERM-grace flush (train/preempt.py → pipeline driver):
+        make everything recorded so far DURABLE before the process
+        dies — both async checkpoint lines flushed and closed with
+        BOUNDED waits (train/checkpoint.py, ``LFM_CKPT_WAIT_S``), so a
+        wedged writer can never eat the whole grace window. The
+        progress sidecar was already written by :meth:`end_epoch`; once
+        the lines commit it is consistent, and a resume continues from
+        exactly the last recorded epoch with identical history. If a
+        wait times out (loud warning), the sidecar runs ahead of the
+        uncommitted line and :meth:`resume`'s skew reconciliation takes
+        over — degraded to the crash contract, never corrupt."""
+        if not self.latest_mgr:
+            return
+        self.best_mgr.close()
+        self.latest_mgr.close()
+
     def finalize(self, abstract_state_dict) -> Optional[Dict[str, Any]]:
         """Flush in-flight async saves, restore the best state (if any)
         and close the managers. The wait precedes the restore: the best
         checkpoint being read may still be committing."""
         best = None
+        best_durable = True
         if self.latest_mgr:
-            self.best_mgr.wait()
+            best_durable = self.best_mgr.wait()
             self.latest_mgr.wait()
         if (self.best_mgr and self.best_epoch >= 0
                 and self.best_mgr.latest_step() is not None):
+            if not best_durable:
+                # Bounded wait timed out with the best save in flight:
+                # latest_step() only reports COMMITTED steps, so the
+                # restore below may hand back an OLDER best than the
+                # recorded best_epoch — loud, never silent.
+                import warnings
+
+                warnings.warn(
+                    f"best checkpoint line still uncommitted after the "
+                    f"bounded wait (epoch {self.best_epoch} recorded) — "
+                    "restoring the newest COMMITTED best instead, which "
+                    "may be older", RuntimeWarning, stacklevel=2)
             best = restore_state_dict(self.best_mgr, abstract_state_dict)
         if self.latest_mgr:
             self.latest_mgr.close()
@@ -1448,9 +1481,18 @@ class Trainer:
             history.append(rec)
             return step, val_ic
 
-        state, overrun = pipeline.run_fit_epochs(
-            harness, state, build=build, dispatch=dispatch, finish=finish,
-            timer=timer, checkpointing=self.run_dir is not None)
+        try:
+            state, overrun = pipeline.run_fit_epochs(
+                harness, state, build=build, dispatch=dispatch,
+                finish=finish, timer=timer,
+                checkpointing=self.run_dir is not None)
+        except pipeline.preempt.Preempted:
+            # SIGTERM grace stop: everything recorded is durable (the
+            # driver ran preempt_flush); flush the metrics stream and
+            # let the preemption propagate to the entry point (exit 75
+            # → re-run with --resume continues with identical history).
+            logger.close()
+            raise
 
         # Restore best state for downstream prediction/backtest.
         best = harness.finalize(state._asdict())

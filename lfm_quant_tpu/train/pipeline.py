@@ -55,7 +55,7 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.train import preempt, reuse
 from lfm_quant_tpu.utils import telemetry
 from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS, timed_device_get
 
@@ -200,6 +200,14 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
     (its results were discarded; None when the stop was clean). The
     harness's counters (``last_epoch``, ``bad_epochs``) always reflect
     RECORDED epochs only, so ``epochs_run`` is pipeline-invariant.
+
+    Preemption (train/preempt.py, DESIGN.md §18): the loop runs inside
+    a SIGTERM ``grace_scope``; a signal stops it at the next iteration
+    boundary — the in-flight epoch settles (recorded, checkpointed),
+    the harness's ``preempt_flush`` (duck-typed, optional) makes the
+    checkpoint lines durable with bounded waits, and
+    :class:`~lfm_quant_tpu.train.preempt.Preempted` propagates so the
+    entry point can exit 75 for a clean ``--resume``.
     """
     async_mode = reuse.async_enabled()
     prefetch = EpochPrefetcher(build) if async_mode else None
@@ -240,65 +248,94 @@ def run_fit_epochs(harness, state, *, build, dispatch, finish, timer,
     inflight: Optional[_InFlight] = None
     overrun: Optional[int] = None
     try:
-        while epoch is not None:
-            if prefetch is not None:
-                with telemetry.span("sample_wait", epoch=epoch):
-                    batches, fm = prefetch.get(epoch)
-            else:
-                batches, fm = build(epoch)
-            if drained_at is not None:
-                REUSE_COUNTERS.device_idle_s += (
-                    time.perf_counter() - drained_at)
-                drained_at = None
-            if probe is not None and probe[1]:
-                REUSE_COUNTERS.device_idle_s += (
-                    time.perf_counter() - probe[0])
-            probe = None
-            # Epoch span: dispatch → settle. Under lookahead these
-            # OVERLAP (epoch e+1 dispatches before e settles), hence an
-            # async telemetry span, not a nested one.
-            esp = telemetry.begin_async("epoch", epoch=epoch)
-            with telemetry.span("dispatch", epoch=epoch):
-                state, vals = dispatch(state, batches)
-                snap = _snapshot(state, checkpointing, async_mode)
-            if not async_mode:
-                if settle(_InFlight(epoch, vals, snap, fm, esp),
-                          drained=True):
-                    break
-                epoch = harness.next_epoch()
-                continue
-            # Lookahead: stage e+1's batches and (below) dispatch e+1
-            # BEFORE syncing e's metrics. The stop decision lags one
-            # epoch, so the harness's epoch counter only advances when
-            # the PREVIOUS epoch settles as "continue" — an epoch that
-            # turns out to be the overrun is never recorded anywhere.
-            cand = epoch + 1 if epoch + 1 < harness.epochs else None
-            if cand is not None:
-                prefetch.start(cand)
+        with preempt.grace_scope():
+            while epoch is not None:
+                if preempt.requested():
+                    # SIGTERM grace stop (train/preempt.py, DESIGN.md
+                    # §18): settle the in-flight epoch — recorded and
+                    # checkpointed like any other, never discarded —
+                    # flush the async checkpoint lines (bounded), and
+                    # raise. The next dispatch never happens, so the
+                    # grace window is spent committing work, not
+                    # computing more of it.
+                    if inflight is not None:
+                        settle(inflight, drained=True)
+                        last: Optional[int] = inflight.epoch
+                        inflight = None
+                    else:
+                        # Nothing in flight (lock-step mode, or before
+                        # the first async dispatch): the harness counter
+                        # already points at the NEXT epoch to dispatch,
+                        # so the last recorded epoch is one behind it
+                        # (resumed fits count the predecessor run's
+                        # epochs); < 0 means nothing ever settled.
+                        le = getattr(harness, "last_epoch", 0) - 1
+                        last = le if le >= 0 else None
+                    flush = getattr(harness, "preempt_flush", None)
+                    if flush is not None:
+                        flush()
+                    telemetry.instant("preempted", cat="fit", epoch=last)
+                    raise preempt.Preempted(last)
+                if prefetch is not None:
+                    with telemetry.span("sample_wait", epoch=epoch):
+                        batches, fm = prefetch.get(epoch)
+                else:
+                    batches, fm = build(epoch)
+                if drained_at is not None:
+                    REUSE_COUNTERS.device_idle_s += (
+                        time.perf_counter() - drained_at)
+                    drained_at = None
+                if probe is not None and probe[1]:
+                    REUSE_COUNTERS.device_idle_s += (
+                        time.perf_counter() - probe[0])
+                probe = None
+                # Epoch span: dispatch → settle. Under lookahead these
+                # OVERLAP (epoch e+1 dispatches before e settles), hence
+                # an async telemetry span, not a nested one.
+                esp = telemetry.begin_async("epoch", epoch=epoch)
+                with telemetry.span("dispatch", epoch=epoch):
+                    state, vals = dispatch(state, batches)
+                    snap = _snapshot(state, checkpointing, async_mode)
+                if not async_mode:
+                    if settle(_InFlight(epoch, vals, snap, fm, esp),
+                              drained=True):
+                        break
+                    epoch = harness.next_epoch()
+                    continue
+                # Lookahead: stage e+1's batches and (below) dispatch
+                # e+1 BEFORE syncing e's metrics. The stop decision lags
+                # one epoch, so the harness's epoch counter only
+                # advances when the PREVIOUS epoch settles as
+                # "continue" — an epoch that turns out to be the overrun
+                # is never recorded anywhere.
+                cand = epoch + 1 if epoch + 1 < harness.epochs else None
+                if cand is not None:
+                    prefetch.start(cand)
+                if inflight is not None:
+                    if settle(inflight, drained=False):
+                        # Early stop with `epoch` speculatively in
+                        # flight: roll the returned state back to the
+                        # last RECORDED epoch's snapshot so downstream
+                        # consumers (predict, walk-forward warm starts)
+                        # see the same state the lock-step loop would
+                        # have ended on.
+                        overrun = epoch
+                        esp.end(discarded=True)
+                        telemetry.instant("lookahead_overrun", epoch=epoch)
+                        if inflight.snap is not None:
+                            state = inflight.snap
+                        inflight = None
+                        break
+                    stepped = harness.next_epoch()
+                    if stepped != epoch:  # pragma: no cover — invariant
+                        raise RuntimeError(
+                            f"pipeline epoch skew: dispatched {epoch}, "
+                            f"harness advanced to {stepped}")
+                inflight = _InFlight(epoch, vals, snap, fm, esp)
+                probe = (time.perf_counter(), _all_ready(vals))
+                epoch = cand
             if inflight is not None:
-                if settle(inflight, drained=False):
-                    # Early stop with `epoch` speculatively in flight:
-                    # roll the returned state back to the last RECORDED
-                    # epoch's snapshot so downstream consumers (predict,
-                    # walk-forward warm starts) see the same state the
-                    # lock-step loop would have ended on.
-                    overrun = epoch
-                    esp.end(discarded=True)
-                    telemetry.instant("lookahead_overrun", epoch=epoch)
-                    if inflight.snap is not None:
-                        state = inflight.snap
-                    inflight = None
-                    break
-                stepped = harness.next_epoch()
-                if stepped != epoch:  # pragma: no cover — invariant
-                    raise RuntimeError(
-                        f"pipeline epoch skew: dispatched {epoch}, "
-                        f"harness advanced to {stepped}")
-            inflight = _InFlight(epoch, vals, snap, fm, esp)
-            probe = (time.perf_counter(), _all_ready(vals))
-            epoch = cand
-        if inflight is not None:
-            settle(inflight, drained=True)
+                settle(inflight, drained=True)
     finally:
         if prefetch is not None:
             prefetch.cancel()

@@ -186,7 +186,15 @@ def timed_device_get(tree):
     ``host_sync_s``. The training loop routes EVERY blocking device→host
     fetch through here, which is what makes "one sync per epoch" a
     measured property (fold records in train/walkforward.py, the
-    ``epoch_pipeline`` bench metric) instead of a claim."""
+    ``epoch_pipeline`` bench metric) instead of a claim.
+
+    Also a chaos-lane fault site (``device_get``, utils/faults.py):
+    every counted host sync is injectable, so the failure path of "the
+    one blocking fetch per epoch died" is testable on demand. Exact
+    no-op when ``LFM_FAULTS`` is unset."""
+    from lfm_quant_tpu.utils import faults
+
+    faults.check("device_get")
     t0 = time.perf_counter()
     out = jax.device_get(tree)
     COUNTERS.bump("host_syncs")

@@ -316,6 +316,22 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
             # snapshots counters AFTER warmup to pin zero.
             "jit_traces_run": counters.get("jit_traces", 0),
             "panel_transfers_run": counters.get("panel_transfers", 0),
+            # Degradation accounting (DESIGN.md §18): shed/dropped/
+            # retried request counts from the run-record counter deltas,
+            # breaker transitions from the circuit_* instants (the
+            # circuit_state gauge is a snapshot, not a delta — the
+            # instants are the durable record of each open/close).
+            "shed": int(counters.get("serve_shed", 0) or 0),
+            "deadline_drops": int(
+                counters.get("serve_deadline_drops", 0) or 0),
+            "retries": int(counters.get("serve_retries", 0) or 0),
+            "breaker_opens": (
+                sum(1 for s in spans if s.get("name") == "circuit_open")
+                or int(counters.get("serve_breaker_opens", 0) or 0)),
+            "breaker_closes": sum(1 for s in spans
+                                  if s.get("name") == "circuit_closed"),
+            "faults_injected": int(
+                counters.get("faults_injected", 0) or 0),
         }
     m = run["manifest"]
     if m:
@@ -420,6 +436,13 @@ def print_report(rep: Dict[str, Any]) -> None:
               f"occupancy {sv.get('mean_occupancy')}  "
               f"queue<= {sv.get('queue_depth_max')}  "
               f"swaps {sv.get('zoo_swaps')}")
+        if any(sv.get(k) for k in ("shed", "deadline_drops", "retries",
+                                   "breaker_opens", "faults_injected")):
+            print(f"  degraded  : shed {sv.get('shed', 0)}  "
+                  f"deadline_drops {sv.get('deadline_drops', 0)}  "
+                  f"retries {sv.get('retries', 0)}  "
+                  f"breaker_opens {sv.get('breaker_opens', 0)}  "
+                  f"faults_injected {sv.get('faults_injected', 0)}")
     print(f"host syncs  : {rep['host_syncs']} "
           f"({rep['syncs_per_epoch']}/epoch, {rep['host_sync_s']:.3f}s "
           f"blocked)" if rep["syncs_per_epoch"] is not None else

@@ -18,7 +18,17 @@ stdlib JSON endpoint until interrupted:
 
     GET /score?universe=u0&month=199001   → scores for the month
     GET /stats                            → the stats() rollup
-    GET /healthz                          → 200 ok
+    GET /healthz                          → 200 ok | 503 + reason
+
+Failure semantics (the degradation layer, DESIGN.md §18 — mapping in
+lfm_quant_tpu/serve/errors.py, pinned by tests/test_chaos.py):
+
+    shed (queue at LFM_SERVE_QUEUE_MAX)     → 429 + Retry-After
+    circuit open (consecutive failures)     → 503 + Retry-After
+    deadline expired / client timed out     → 504
+    batcher thread dead (service unready)   → 503
+    unknown universe / month                → 404
+    /healthz degraded                       → 503 + {"ok": false, reason}
 
 Usage:
     python serve.py --universes 3 --requests 200 --run-dir runs/serve
@@ -118,18 +128,26 @@ def drive_load(service, n_requests: int, n_threads: int,
 def run_http(service, port: int):
     """Minimal stdlib JSON front door (demo-grade: one service, GET
     only; a production deployment would sit behind a real gateway)."""
+    from concurrent.futures import TimeoutError as FutureTimeout
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
     from urllib.parse import parse_qs, urlparse
+
+    from lfm_quant_tpu.serve.errors import ServeError, http_status
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, code: int, payload):
+        def _send(self, code: int, payload, retry_after_s=None):
             body = json.dumps(payload, default=str).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after_s is not None:
+                # HTTP Retry-After is whole seconds; never advertise 0
+                # (clients would hot-loop the open circuit).
+                self.send_header("Retry-After",
+                                 str(max(1, int(retry_after_s + 0.999))))
             self.end_headers()
             self.wfile.write(body)
 
@@ -137,7 +155,13 @@ def run_http(service, port: int):
             url = urlparse(self.path)
             try:
                 if url.path == "/healthz":
-                    return self._send(200, {"ok": True})
+                    # REAL readiness (DESIGN.md §18): 503 + reason when
+                    # the batcher is dead or the circuit is open — a
+                    # load balancer must stop routing here, which the
+                    # old constant {"ok": true} prevented.
+                    h = service.health()
+                    return self._send(200 if h.get("ok") else 503, h,
+                                      retry_after_s=h.get("retry_after_s"))
                 if url.path == "/stats":
                     return self._send(200, service.stats())
                 if url.path == "/score":
@@ -153,6 +177,15 @@ def run_http(service, port: int):
                 return self._send(404, {"error": "unknown path"})
             except KeyError as e:
                 return self._send(404, {"error": str(e)})
+            except FutureTimeout:
+                return self._send(504, {"error": "scoring timed out"})
+            except ServeError as e:
+                # The failure-semantics table (module docstring): shed →
+                # 429, open circuit / dead batcher → 503, expired
+                # deadline → 504 — each with Retry-After when known.
+                return self._send(http_status(e),
+                                  {"error": f"{type(e).__name__}: {e}"},
+                                  retry_after_s=e.retry_after_s)
             except Exception as e:  # noqa: BLE001 — a request must answer
                 return self._send(500, {"error": f"{type(e).__name__}: {e}"})
 

@@ -1,0 +1,620 @@
+"""Chaos lane (``-m chaos``): failure paths pinned, not hoped for.
+
+The fault-injection harness (utils/faults.py) makes failures happen on
+demand — deterministic, seeded, site-addressed — and this module uses
+it to pin the graceful-degradation contracts (DESIGN.md §18):
+
+* the harness itself: spec parsing, schedule determinism, loud errors,
+  and the MEASURED non-interference contract (LFM_FAULTS unset ⇒ a
+  warm fit pays zero jit traces, zero panel H2D, one host sync/epoch —
+  the same counters as before the fault layer existed);
+* serving: transient dispatch faults recover via bounded retry with
+  BIT-EQUAL responses and zero recompiles; retry exhaustion fails
+  loudly; consecutive failures open the circuit breaker (fast-fail +
+  retry-after, real /healthz readiness) and a half-open probe recovers
+  it; a full queue SHEDS instead of growing without bound; expired
+  deadlines are dropped BEFORE dispatch; a dead batcher thread fails
+  pending and future requests fast instead of hanging clients;
+* checkpointing: the ``ckpt_write`` fault site fires; a wedged async
+  save can no longer hang shutdown (bounded wait + loud warning);
+* preemption: a SIGTERM mid-epoch — delivered at an exact fault-site
+  call, in-process and in a real subprocess — grace-stops with the
+  recorded epochs durable, and a resume reproduces the uninterrupted
+  fit's history and best params EXACTLY.
+
+Module named early in the alphabet on purpose: it must sort before the
+tier-1 timebox cut (ROADMAP tier-1 notes).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.data.windows import clear_panel_cache
+from lfm_quant_tpu.serve import ScoringService
+from lfm_quant_tpu.serve import errors as serrors
+from lfm_quant_tpu.train import preempt, reuse
+from lfm_quant_tpu.train.checkpoint import CheckpointManager
+from lfm_quant_tpu.train.loop import Trainer, restore_state_dict
+from lfm_quant_tpu.utils import faults, telemetry
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(n_firms=60, window=8, seed=0, epochs=1, name="chaos_t"):
+    return RunConfig(
+        name=name,
+        data=DataConfig(n_firms=n_firms, n_months=160, n_features=5,
+                        window=window, dates_per_batch=4,
+                        firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=epochs, warmup_steps=2,
+                          loss="mse"),
+        seed=seed,
+    )
+
+
+def _universe(n_firms=60, window=8, seed=0, panel_seed=3, fit=False):
+    panel = synthetic_panel(n_firms=n_firms, n_months=160, n_features=5,
+                            seed=panel_seed)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    tr = Trainer(_cfg(n_firms=n_firms, window=window, seed=seed), splits)
+    if fit:
+        tr.fit()
+    else:
+        tr.state = tr.init_state()
+    return tr, panel, splits
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene(monkeypatch):
+    """No fault schedule, no stale preemption flag, fresh caches — in
+    AND out, so a failing chaos test can never poison its neighbors."""
+    monkeypatch.delenv("LFM_FAULTS", raising=False)
+    faults.configure("")
+    preempt.clear()
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    yield
+    faults.configure("")
+    preempt.clear()
+    reuse.clear_program_cache()
+    clear_panel_cache()
+
+
+# ---- the harness itself --------------------------------------------------
+
+
+def test_fault_spec_parsing_and_determinism():
+    plans = faults.parse_spec(
+        "serve_dispatch:p=0.5,seed=7,n=3;ckpt_write:at=1+3,kind=permanent")
+    assert set(plans) == {"serve_dispatch", "ckpt_write"}
+    assert plans["serve_dispatch"].limit == 3
+    assert plans["ckpt_write"].at == frozenset({1, 3})
+    assert plans["ckpt_write"].kind == "permanent"
+    # Seeded p-mode schedules are a pure function of (seed, call index).
+    a = faults.parse_spec("device_get:p=0.3,seed=11")["device_get"]
+    b = faults.parse_spec("device_get:p=0.3,seed=11")["device_get"]
+    fires_a = [a.fire() is not None for _ in range(64)]
+    fires_b = [b.fire() is not None for _ in range(64)]
+    assert fires_a == fires_b
+    assert any(fires_a) and not all(fires_a)
+    # A different seed is a different schedule.
+    c = faults.parse_spec("device_get:p=0.3,seed=12")["device_get"]
+    assert [c.fire() is not None for _ in range(64)] != fires_a
+
+
+def test_fault_spec_loud_on_garbage():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        faults.parse_spec("nope:p=1")
+    with pytest.raises(ValueError, match="kind"):
+        faults.parse_spec("ckpt_write:kind=weird")
+    with pytest.raises(ValueError, match="unknown key"):
+        faults.parse_spec("ckpt_write:frequency=2")
+    with pytest.raises(ValueError, match="duplicate"):
+        faults.parse_spec("ckpt_write:at=0;ckpt_write:at=1")
+
+
+def test_fault_kinds_and_counters():
+    faults.configure("device_get:at=0")
+    snap = telemetry.COUNTERS.snapshot()
+    with pytest.raises(faults.TransientFault) as ei:
+        faults.check("device_get")
+    assert serrors.is_transient(ei.value)
+    faults.check("device_get")  # call 1: not scheduled — no raise
+    d = telemetry.COUNTERS.delta(snap)
+    assert d.get("faults_injected") == 1 and d.get("fault_device_get") == 1
+    faults.configure("device_get:kind=permanent,n=1")
+    with pytest.raises(faults.PermanentFault) as ei:
+        faults.check("device_get")
+    assert not serrors.is_transient(ei.value)
+    faults.check("device_get")  # budget n=1 spent — site is quiet now
+
+
+def test_faults_unset_is_exact_noop_and_fit_non_interference(monkeypatch):
+    """The measured non-interference contract: with LFM_FAULTS unset
+    the fault layer — wired into serve_dispatch, panel_h2d, zoo_lease,
+    ckpt_write AND device_get — adds zero jit traces, zero panel H2D
+    and zero extra host syncs to a warm fit (the reuse/pipeline lane
+    numbers, unchanged)."""
+    monkeypatch.delenv("LFM_FAULTS", raising=False)
+    faults.configure()
+    assert not faults.active()
+    faults.check("serve_dispatch")  # no spec → returns, raises nothing
+    panel = synthetic_panel(n_firms=60, n_months=160, n_features=5, seed=3)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    tr = Trainer(_cfg(epochs=2), splits)
+    tr.fit()  # cold: compiles + panel transfer
+    snap = REUSE_COUNTERS.snapshot()
+    tr.rebind()
+    out = tr.fit()  # warm
+    d = REUSE_COUNTERS.delta(snap)
+    assert d.get("jit_traces", 0) == 0, d
+    assert d.get("panel_transfers", 0) == 0, d
+    assert d.get("host_syncs", 0) == out["epochs_run"], d
+
+
+# ---- serving: retry / breaker / shed / deadline / death ------------------
+
+
+def test_transient_dispatch_fault_retries_bit_equal_zero_recompiles(
+        tmp_path):
+    """The acceptance pin: under injected transient dispatch faults the
+    service recovers via bounded retry with ZERO incorrect responses
+    and ZERO steady-state recompiles — and the degradation counters
+    surface in trace_report's serve section from the run dir alone."""
+    run_dir = str(tmp_path / "chaos_serve")
+    assert telemetry._ACTIVE is None
+    svc = ScoringService(max_rows=4, max_wait_ms=1.0)
+    try:
+        tr, _, _ = _universe(fit=True)
+        svc.register("us", tr)
+        m = svc.serveable_months("us")[5]
+        ref = svc.score("us", m).scores.copy()
+        with telemetry.run_scope(run_dir, extra={"entry": "test_chaos"}):
+            snap = REUSE_COUNTERS.snapshot()
+            # Two transient faults, default LFM_SERVE_RETRIES=2 → the
+            # third attempt of the SAME batch succeeds.
+            faults.configure("serve_dispatch:n=2,kind=transient")
+            r = svc.score("us", m)
+            np.testing.assert_array_equal(r.scores, ref)
+            d = REUSE_COUNTERS.delta(snap)
+            assert d.get("jit_traces", 0) == 0, d
+            assert d.get("panel_transfers", 0) == 0, d
+        stats = svc.batcher.stats()
+        assert stats["retries"] == 2
+        assert stats["circuit"] == "closed"
+        assert svc.health()["ok"]
+    finally:
+        svc.close()
+    from lfm_quant_tpu.serve.stats import load_trace_report
+
+    tr_mod = load_trace_report(REPO)
+    sv = tr_mod.build_report(tr_mod.load_run(run_dir)).get("serve")
+    assert sv is not None
+    assert sv["retries"] == 2
+    assert sv["faults_injected"] == 2
+    assert sv["jit_traces_run"] == 0
+
+
+def test_retry_exhaustion_fails_loudly_then_recovers():
+    svc = ScoringService(max_rows=4, max_wait_ms=0.5, retries=1,
+                         breaker_threshold=0)
+    try:
+        tr, _, _ = _universe()
+        svc.register("us", tr)
+        m = svc.serveable_months("us")[5]
+        svc.score("us", m)  # settle
+        faults.configure("serve_dispatch:n=10,kind=transient")
+        with pytest.raises(faults.TransientFault):
+            svc.score("us", m, timeout=30)
+        faults.configure("")
+        r = svc.score("us", m)  # healed backend → next request serves
+        assert r.scores.size > 0
+    finally:
+        svc.close()
+
+
+def test_circuit_breaker_opens_fast_fails_half_open_recovers():
+    svc = ScoringService(max_rows=2, max_wait_ms=0.0, retries=0,
+                         breaker_threshold=2, breaker_cooldown_ms=80)
+    try:
+        tr, _, _ = _universe()
+        svc.register("us", tr)
+        m = svc.serveable_months("us")[5]
+        svc.score("us", m)  # settle the healthy path
+        faults.configure("serve_dispatch:kind=permanent")  # every call
+        for _ in range(2):  # streak reaches the threshold
+            with pytest.raises(faults.PermanentFault):
+                svc.score("us", m, timeout=30)
+        # OPEN: real readiness + fast-fail with retry-after.
+        h = svc.health()
+        assert not h["ok"] and h["circuit"] == "open"
+        assert "circuit open" in h["reason"]
+        assert h["retry_after_s"] >= 0
+        with pytest.raises(serrors.CircuitOpenError) as ei:
+            svc.score("us", m, timeout=30)
+        assert ei.value.http_status == 503
+        assert svc.batcher.stats()["breaker_opens"] == 1
+        assert telemetry.COUNTERS.get("circuit_state") == 2
+        # Cooldown elapses with the backend HEALED → the half-open
+        # probe succeeds and the circuit closes.
+        faults.configure("")
+        time.sleep(0.1)
+        r = svc.score("us", m)
+        assert r.scores.size > 0
+        h = svc.health()
+        assert h["ok"] and h["circuit"] == "closed"
+        assert telemetry.COUNTERS.get("circuit_state") == 0
+    finally:
+        svc.close()
+
+
+def test_half_open_probe_failure_reopens():
+    svc = ScoringService(max_rows=2, max_wait_ms=0.0, retries=0,
+                         breaker_threshold=1, breaker_cooldown_ms=40)
+    try:
+        tr, _, _ = _universe()
+        svc.register("us", tr)
+        m = svc.serveable_months("us")[5]
+        svc.score("us", m)
+        faults.configure("serve_dispatch:kind=permanent")
+        with pytest.raises(faults.PermanentFault):
+            svc.score("us", m, timeout=30)  # opens (threshold 1)
+        assert not svc.health()["ok"]
+        time.sleep(0.06)  # cooldown elapsed, backend STILL broken
+        with pytest.raises(faults.PermanentFault):
+            svc.score("us", m, timeout=30)  # the probe fails
+        h = svc.health()  # ... which re-opened the circuit instantly
+        assert not h["ok"] and h["circuit"] == "open"
+        assert svc.batcher.stats()["breaker_opens"] == 2
+    finally:
+        faults.configure("")
+        svc.close()
+
+
+def test_overload_sheds_instead_of_unbounded_queue():
+    """2×-overload semantics at unit scale: a burst beyond the queue
+    bound sheds in O(1) (429-path), the queue never exceeds the bound,
+    and every ADMITTED request completes."""
+    svc = ScoringService(max_rows=1, max_wait_ms=0.0, queue_max=8,
+                         retries=0, breaker_threshold=0)
+    try:
+        tr, _, _ = _universe()
+        svc.register("us", tr)
+        months = svc.serveable_months("us")
+        svc.score("us", months[0])  # settle
+        snap = telemetry.COUNTERS.snapshot()
+        futures = [svc.submit("us", months[k % len(months)])
+                   for k in range(120)]
+        shed = completed = 0
+        for f in futures:
+            try:
+                f.result(timeout=60)
+                completed += 1
+            except serrors.ShedError as e:
+                assert e.http_status == 429
+                shed += 1
+        assert shed > 0, "burst never overflowed the bounded queue"
+        assert completed == 120 - shed
+        assert svc.batcher.stats()["shed"] == shed
+        assert svc.batcher.stats()["queue_peak"] <= 8
+        assert telemetry.COUNTERS.delta(snap).get("serve_shed") == shed
+    finally:
+        svc.close()
+
+
+def test_expired_deadline_dropped_before_dispatch():
+    svc = ScoringService(max_rows=2, max_wait_ms=0.0, retries=0)
+    try:
+        tr, _, _ = _universe()
+        svc.register("us", tr)
+        m = svc.serveable_months("us")[5]
+        f = svc.submit("us", m, deadline_ms=0.001)  # expired by dispatch
+        with pytest.raises(serrors.DeadlineError) as ei:
+            f.result(timeout=30)
+        assert ei.value.http_status == 504
+        stats = svc.batcher.stats()
+        assert stats["deadline_drops"] == 1
+        # Dropped BEFORE dispatch: no batch was ever dispatched for it
+        # (registration warmup bypasses the batcher, so batches==0).
+        assert stats["batches"] == 0
+        # A sane deadline (score's client timeout propagates as one)
+        # serves normally.
+        r = svc.score("us", m, timeout=30)
+        assert r.scores.size > 0
+        assert svc.batcher.stats()["deadline_drops"] == 1
+    finally:
+        svc.close()
+
+
+def test_batcher_thread_death_fails_pending_and_fast_fails(recwarn):
+    """Satellite pin: if the batcher loop dies OUTSIDE the per-batch
+    failure path, pending futures fail LOUDLY, the service reports
+    unready, and subsequent submits fail fast — no client ever hangs
+    to its timeout."""
+    svc = ScoringService(max_rows=1, max_wait_ms=0.0, retries=5,
+                         breaker_threshold=0)
+    try:
+        tr, _, _ = _universe()
+        svc.register("us", tr)
+        m = svc.serveable_months("us")[5]
+        svc.score("us", m)  # settle
+        # Keep the batcher busy (injected transient faults × 5 retries
+        # of backoff ≥ ~30 ms) while the death is staged behind it.
+        faults.configure("serve_dispatch:n=50,kind=transient")
+        f1 = svc.submit("us", m)
+        deadline = time.perf_counter() + 5.0
+        while svc.batcher._queue and time.perf_counter() < deadline:
+            time.sleep(0.001)  # until the batcher popped f1
+        boom = RuntimeError("boom in _next_batch")
+
+        def dead_next_batch():
+            raise boom
+
+        svc.batcher._next_batch = dead_next_batch
+        f2 = svc.submit("us", m)
+        f3 = svc.submit("us", m)
+        with pytest.raises(faults.TransientFault):
+            f1.result(timeout=30)  # retries exhausted on the fault
+        with pytest.raises(serrors.BatcherDeadError):
+            f2.result(timeout=30)  # pending at death → failed loudly
+        with pytest.raises(serrors.BatcherDeadError):
+            f3.result(timeout=30)
+        h = svc.health()
+        assert not h["ok"] and h["circuit"] == "dead"
+        assert "batcher thread dead" in h["reason"]
+        with pytest.raises(serrors.BatcherDeadError):
+            svc.submit("us", m).result(timeout=30)  # fail-fast submit
+        assert telemetry.COUNTERS.get("serve_batcher_dead") == 1
+        assert any("batcher thread died" in str(w.message)
+                   for w in recwarn.list)
+    finally:
+        faults.configure("")
+        telemetry.COUNTERS.set("serve_batcher_dead", 0)
+        svc.close()
+
+
+def test_http_status_mapping():
+    """The serve.py failure-semantics table (one mapping, errors.py)."""
+    assert serrors.http_status(serrors.ShedError(8)) == 429
+    assert serrors.http_status(serrors.CircuitOpenError(0.2)) == 503
+    assert serrors.http_status(
+        serrors.DeadlineError("us", 199001, 0.1)) == 504
+    assert serrors.http_status(
+        serrors.BatcherDeadError(RuntimeError("x"))) == 503
+    assert serrors.http_status(KeyError("us")) == 404
+    assert serrors.http_status(RuntimeError("?")) == 500
+    assert serrors.CircuitOpenError(0.2).retry_after_s == pytest.approx(0.2)
+    assert serrors.ShedError(8).retry_after_s > 0
+
+
+def test_zoo_lease_and_panel_h2d_sites_fire():
+    """The other serving-side fault sites are really wired: an injected
+    zoo_lease fault surfaces through the dispatch retry layer exactly
+    like a dispatch fault (it is inside the retried region)."""
+    svc = ScoringService(max_rows=2, max_wait_ms=0.0, retries=1,
+                         breaker_threshold=0)
+    try:
+        tr, _, _ = _universe()
+        svc.register("us", tr)
+        m = svc.serveable_months("us")[5]
+        ref = svc.score("us", m).scores.copy()
+        faults.configure("zoo_lease:n=1,kind=transient")
+        r = svc.score("us", m)  # one lease fault → one retry → served
+        np.testing.assert_array_equal(r.scores, ref)
+        assert svc.batcher.stats()["retries"] >= 1
+    finally:
+        svc.close()
+    clear_panel_cache()
+    faults.configure("panel_h2d:n=1,kind=permanent")
+    with pytest.raises(faults.PermanentFault):
+        _universe(panel_seed=17)  # trainer construction transfers panel
+    faults.configure("")
+    _universe(panel_seed=17)  # healed: the cold transfer proceeds
+
+
+# ---- checkpointing: ckpt_write site + bounded waits ----------------------
+
+
+def test_ckpt_write_fault_site_fires_and_heals(tmp_path):
+    faults.configure("ckpt_write:at=0")
+    mgr = CheckpointManager(str(tmp_path / "latest"))
+    state = {"x": np.zeros(3, np.float32)}
+    with pytest.raises(faults.TransientFault):
+        mgr.save(1, state)
+    faults.configure("")
+    mgr.save(1, state, wait=True)
+    assert mgr.latest_step() == 1
+    mgr.close()
+
+
+def test_ckpt_wait_bounded_never_hangs(tmp_path, monkeypatch):
+    """Satellite pin: a wedged async Orbax writer can no longer hang
+    shutdown — the wait is bounded (LFM_CKPT_WAIT_S), warns loudly,
+    and close() abandons instead of blocking forever."""
+    mgr = CheckpointManager(str(tmp_path / "latest"))
+    release = threading.Event()
+    monkeypatch.setattr(mgr._mgr, "wait_until_finished",
+                        lambda: release.wait(30))
+    t0 = time.perf_counter()
+    with pytest.warns(RuntimeWarning, match="still\\s+unfinished"):
+        ok = mgr.wait(timeout_s=0.1)
+    assert ok is False
+    assert time.perf_counter() - t0 < 5.0
+    assert telemetry.COUNTERS.get("ckpt_wait_timeouts") >= 1
+    with pytest.warns(RuntimeWarning, match="abandoned"):
+        mgr.close(timeout_s=0.1)
+    release.set()  # let the daemon waiter drain
+
+
+# ---- preemption: SIGTERM grace + identical resume ------------------------
+
+
+def _read_history(run_dir):
+    """metrics.jsonl → {epoch: (val_ic, train_loss)}, last line wins
+    (a resumed run appends to the same stream)."""
+    out = {}
+    with open(os.path.join(run_dir, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "epoch" in rec:
+                out[rec["epoch"]] = (rec["val_ic"], rec["train_loss"])
+    return out
+
+
+def _best_params(run_dir, trainer):
+    mgr = CheckpointManager(os.path.join(run_dir, "ckpt", "best"))
+    restored = restore_state_dict(mgr, trainer.init_state()._asdict())
+    mgr.close()
+    return restored["params"]
+
+
+def test_sigterm_grace_stop_and_identical_resume_in_process(tmp_path):
+    """SIGTERM at a fault-injected ckpt_write: the fit grace-stops with
+    recorded epochs durable (Preempted), and a resume reproduces the
+    uninterrupted fit's history and best params EXACTLY."""
+    import jax
+
+    cfg = _cfg(epochs=4, name="chaos_pre")
+    panel = synthetic_panel(n_firms=60, n_months=160, n_features=5, seed=3)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    run_a = str(tmp_path / "a")
+    run_b = str(tmp_path / "b")
+    # Reference: uninterrupted fit.
+    ref = Trainer(cfg, splits, run_dir=run_b)
+    out_ref = ref.fit()
+    # Interrupted fit: SIGTERM delivered at the 3rd checkpoint write
+    # (mid-fit, epoch 1's end_epoch) — the grace handler settles the
+    # in-flight epoch, flushes both lines, and raises.
+    faults.configure("ckpt_write:at=2,kind=sigterm")
+    tr = Trainer(cfg, splits, run_dir=run_a)
+    with pytest.raises(preempt.Preempted):
+        tr.fit()
+    faults.configure("")
+    preempt.clear()
+    part = _read_history(run_a)
+    assert 0 < len(part) < out_ref["epochs_run"], part
+    # Resume: continues from the last recorded epoch.
+    tr2 = Trainer(cfg, splits, run_dir=run_a)
+    out2 = tr2.fit(resume=True)
+    assert out2["best_epoch"] == out_ref["best_epoch"]
+    hist_a, hist_b = _read_history(run_a), _read_history(run_b)
+    assert hist_a == hist_b  # bit-identical epoch history, end to end
+    pa, pb = _best_params(run_a, tr2), _best_params(run_b, ref)
+    for la, lb in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+_CHILD = """\
+import json, sys
+sys.path.insert(0, sys.argv[2])
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, \\
+    RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.train.loop import Trainer
+from lfm_quant_tpu.train.preempt import Preempted
+
+cfg = RunConfig(
+    name="chaos_child",
+    data=DataConfig(n_firms=60, n_months=160, n_features=5, window=8,
+                    dates_per_batch=4, firms_per_date=32),
+    model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+    optim=OptimConfig(lr=1e-3, epochs=4, warmup_steps=2, loss="mse"),
+    seed=0)
+panel = synthetic_panel(n_firms=60, n_months=160, n_features=5, seed=3)
+splits = PanelSplits.by_date(panel, 197801, 198001)
+tr = Trainer(cfg, splits, run_dir=sys.argv[1])
+try:
+    out = tr.fit(resume="--resume" in sys.argv)
+except Preempted:
+    sys.exit(75)
+print(json.dumps({"best_epoch": out["best_epoch"],
+                  "epochs_run": out["epochs_run"]}))
+"""
+
+
+def test_kill_mid_epoch_subprocess_resumes_identically(tmp_path):
+    """The acceptance pin, as a REAL subprocess: a fit SIGTERM'd at a
+    fault-injected ckpt_write exits 75 (EX_TEMPFAIL) with its recorded
+    epochs durable; rerunning with resume completes, and the combined
+    history + best params equal an uninterrupted fit bit for bit."""
+    script = tmp_path / "child_fit.py"
+    script.write_text(_CHILD)
+    run_dir = str(tmp_path / "run")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("LFM_FAULTS", None)
+
+    def child(*extra, fault=None):
+        e = dict(env)
+        if fault:
+            e["LFM_FAULTS"] = fault
+        return subprocess.run(
+            [sys.executable, str(script), run_dir, REPO, *extra],
+            env=e, capture_output=True, text=True, timeout=240)
+
+    # Kill mid-epoch: the sigterm fault kind delivers the signal at the
+    # 3rd checkpoint write; the grace path exits 75.
+    out1 = child(fault="ckpt_write:at=2,kind=sigterm")
+    assert out1.returncode == 75, (out1.returncode, out1.stderr[-800:])
+    part = _read_history(run_dir)
+    assert len(part) > 0
+    # Resume: exits 0 and completes the remaining epochs.
+    out2 = child("--resume")
+    assert out2.returncode == 0, (out2.returncode, out2.stderr[-800:])
+    summary = json.loads(out2.stdout.strip().splitlines()[-1])
+    # Reference: the same fit, uninterrupted, in this process (same
+    # backend, deterministic samplers ⇒ bit-identical).
+    cfg = RunConfig(
+        name="chaos_child",
+        data=DataConfig(n_firms=60, n_months=160, n_features=5, window=8,
+                        dates_per_batch=4, firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=4, warmup_steps=2, loss="mse"),
+        seed=0)
+    panel = synthetic_panel(n_firms=60, n_months=160, n_features=5, seed=3)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    ref_dir = str(tmp_path / "ref")
+    ref = Trainer(cfg, splits, run_dir=ref_dir)
+    out_ref = ref.fit()
+    assert summary["best_epoch"] == out_ref["best_epoch"]
+    assert _read_history(run_dir) == _read_history(ref_dir)
+    import jax
+
+    pa = _best_params(run_dir, ref)
+    pb = _best_params(ref_dir, ref)
+    for la, lb in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_grace_scope_installs_and_restores_handler():
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+    with preempt.grace_scope():
+        assert signal.getsignal(signal.SIGTERM) is preempt._handler
+        with preempt.grace_scope():  # nested: ref-counted, same handler
+            assert signal.getsignal(signal.SIGTERM) is preempt._handler
+        assert signal.getsignal(signal.SIGTERM) is preempt._handler
+        assert not preempt.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython delivers at the next bytecode boundary; poll briefly.
+        deadline = time.perf_counter() + 2.0
+        while not preempt.requested() and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        assert preempt.requested()
+    assert signal.getsignal(signal.SIGTERM) == prev
+    preempt.clear()

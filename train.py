@@ -214,58 +214,74 @@ def main(argv=None) -> int:
     else:
         run_dir = os.path.join(cfg.out_dir, cfg.name, f"seed{cfg.seed}")
 
-    ctx = contextlib.ExitStack()
-    with ctx:
-        if args.debug:
-            ctx.enter_context(sanitized())
-        ctx.enter_context(trace_context(args.profile))
-        ctx.enter_context(telemetry.run_scope(
-            run_dir, cfg, extra={"entry": "train"}))
-        if args.walk_forward is not None and sweep_grid is not None:
-            from lfm_quant_tpu.train.loop import resolve_panel
-            from lfm_quant_tpu.train.stacked import run_walkforward_sweep
+    from lfm_quant_tpu.train.preempt import Preempted, grace_scope
 
-            panel = resolve_panel(cfg.data)
-            start = args.wf_start or int(
-                panel.dates[int(panel.n_months * 0.6)])
-            summary = run_walkforward_sweep(
-                cfg, sweep_grid, panel=panel, start=start,
-                step_months=args.walk_forward,
-                val_months=args.wf_val_months, n_folds=args.wf_folds,
-                train_months=args.wf_train_months, out_dir=run_dir,
-                echo=args.echo)
-            summary["run_dir"] = run_dir
-        elif args.walk_forward is not None:
-            from lfm_quant_tpu.train.loop import resolve_panel
-            from lfm_quant_tpu.train.walkforward import run_walkforward
+    try:
+        with contextlib.ExitStack() as ctx:
+            if args.debug:
+                ctx.enter_context(sanitized())
+            ctx.enter_context(trace_context(args.profile))
+            ctx.enter_context(telemetry.run_scope(
+                run_dir, cfg, extra={"entry": "train"}))
+            # SIGTERM grace (train/preempt.py, DESIGN.md §18):
+            # preemptible capacity delivers SIGTERM with a grace window;
+            # the scope turns it into a clean stop at the next epoch
+            # boundary with the checkpoint lines flushed, surfaced
+            # below as exit code 75 (EX_TEMPFAIL: re-run with --resume).
+            ctx.enter_context(grace_scope())
+            if args.walk_forward is not None and sweep_grid is not None:
+                from lfm_quant_tpu.train.loop import resolve_panel
+                from lfm_quant_tpu.train.stacked import run_walkforward_sweep
 
-            panel = resolve_panel(cfg.data)
-            start = args.wf_start or int(
-                panel.dates[int(panel.n_months * 0.6)])
-            wf_dir = run_dir
-            _, _, summary = run_walkforward(
-                cfg, panel, start=start, step_months=args.walk_forward,
-                val_months=args.wf_val_months, n_folds=args.wf_folds,
-                out_dir=wf_dir, echo=args.echo, resume=args.resume,
-                warm_start=args.wf_warm_start,
-                train_months=args.wf_train_months,
-                score_modes=wf_score_modes,
-                foldstack=True if args.wf_foldstack else None)
-            summary["run_dir"] = wf_dir
-        elif sweep_grid is not None:
-            from lfm_quant_tpu.train.stacked import run_config_sweep
+                panel = resolve_panel(cfg.data)
+                start = args.wf_start or int(
+                    panel.dates[int(panel.n_months * 0.6)])
+                summary = run_walkforward_sweep(
+                    cfg, sweep_grid, panel=panel, start=start,
+                    step_months=args.walk_forward,
+                    val_months=args.wf_val_months, n_folds=args.wf_folds,
+                    train_months=args.wf_train_months, out_dir=run_dir,
+                    echo=args.echo)
+                summary["run_dir"] = run_dir
+            elif args.walk_forward is not None:
+                from lfm_quant_tpu.train.loop import resolve_panel
+                from lfm_quant_tpu.train.walkforward import run_walkforward
 
-            summary = run_config_sweep(cfg, sweep_grid, out_dir=run_dir,
-                                       echo=args.echo)
-            summary["run_dir"] = run_dir
-        elif cfg.n_seeds > 1:
-            from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
-            summary, _, _ = run_ensemble_experiment(
-                cfg, echo=args.echo, resume=args.resume)
-        else:
-            from lfm_quant_tpu.train.loop import run_experiment
-            summary, _, _ = run_experiment(
-                cfg, echo=args.echo, resume=args.resume)
+                panel = resolve_panel(cfg.data)
+                start = args.wf_start or int(
+                    panel.dates[int(panel.n_months * 0.6)])
+                wf_dir = run_dir
+                _, _, summary = run_walkforward(
+                    cfg, panel, start=start, step_months=args.walk_forward,
+                    val_months=args.wf_val_months, n_folds=args.wf_folds,
+                    out_dir=wf_dir, echo=args.echo, resume=args.resume,
+                    warm_start=args.wf_warm_start,
+                    train_months=args.wf_train_months,
+                    score_modes=wf_score_modes,
+                    foldstack=True if args.wf_foldstack else None)
+                summary["run_dir"] = wf_dir
+            elif sweep_grid is not None:
+                from lfm_quant_tpu.train.stacked import run_config_sweep
+
+                summary = run_config_sweep(cfg, sweep_grid, out_dir=run_dir,
+                                           echo=args.echo)
+                summary["run_dir"] = run_dir
+            elif cfg.n_seeds > 1:
+                from lfm_quant_tpu.train.ensemble import run_ensemble_experiment
+                summary, _, _ = run_ensemble_experiment(
+                    cfg, echo=args.echo, resume=args.resume)
+            else:
+                from lfm_quant_tpu.train.loop import run_experiment
+                summary, _, _ = run_experiment(
+                    cfg, echo=args.echo, resume=args.resume)
+    except Preempted as e:
+        # Graceful preemption: everything recorded is durable. 75 =
+        # EX_TEMPFAIL — the scheduler-facing "transient, re-run me".
+        print(json.dumps({"preempted": True, "detail": str(e),
+                          "run_dir": run_dir,
+                          "resume_hint": "re-run with --resume"},
+                         indent=2))
+        return 75
     print(json.dumps({k: v for k, v in summary.items() if k != "history"},
                      indent=2, default=str))
     return 0
