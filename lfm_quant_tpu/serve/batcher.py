@@ -84,7 +84,7 @@ from lfm_quant_tpu.serve.errors import (
     is_transient,
 )
 from lfm_quant_tpu.serve.zoo import ModelZoo
-from lfm_quant_tpu.utils import faults, telemetry
+from lfm_quant_tpu.utils import faults, metrics, telemetry
 
 
 class ScoreResponse(NamedTuple):
@@ -199,6 +199,7 @@ class MicroBatcher:
             self._emit_half_open()
         if state == "open":
             telemetry.COUNTERS.bump("serve_circuit_rejects")
+            metrics.METRICS.mark("serve_err")
             future.set_exception(CircuitOpenError(open_until - now))
             return future
         try:
@@ -237,6 +238,7 @@ class MicroBatcher:
         if shed:
             span.end(error="shed")
             telemetry.COUNTERS.bump("serve_shed")
+            metrics.METRICS.mark("serve_err")  # availability budget
             with self._stats_lock:
                 self._shed += 1
             future.set_exception(ShedError(self.queue_max))
@@ -312,10 +314,14 @@ class MicroBatcher:
                 except Exception as e:  # noqa: BLE001 — the loop survives
                     with self._stats_lock:
                         self._errors += 1
+                    failed = 0
                     for r in batch:
                         if not r.future.done():
                             r.future.set_exception(e)
+                            failed += 1
                         r.span.end(error=type(e).__name__)
+                    if failed:
+                        metrics.METRICS.mark("serve_err", float(failed))
         except BaseException as e:  # noqa: BLE001 — death guard
             # The loop died OUTSIDE the per-batch failure path (e.g.
             # _next_batch raising): without this guard every pending and
@@ -398,6 +404,7 @@ class MicroBatcher:
             live.append(r)
         if dropped:
             telemetry.COUNTERS.bump("serve_deadline_drops", dropped)
+            metrics.METRICS.mark("serve_err", float(dropped))
             with self._stats_lock:
                 self._deadline_drops += dropped
         return live
@@ -497,15 +504,47 @@ class MicroBatcher:
             t_done = time.perf_counter()
             gen = entry.generation
         lats = []
+        score_slices = []
         for i, r in enumerate(batch):
             pool = pools[i][1]
             lat = round((t_done - r.t_submit) * 1e3, 3)
             lats.append(lat)
+            scores = out[i, :pool.size].copy()
+            score_slices.append(scores)
             r.span.end(latency_ms=lat, generation=gen)
             r.future.set_result(ScoreResponse(
                 universe=universe, month=r.month, generation=gen,
-                firm_idx=pool, scores=out[i, :pool.size].copy(),
-                latency_ms=lat))
+                firm_idx=pool, scores=scores, latency_ms=lat))
+        # Live metrics plane (utils/metrics.py, DESIGN.md §19): O(1)
+        # per event, lock-guarded inside each instrument, exact no-op
+        # under LFM_METRICS=0. Latency attributed per (universe,
+        # width-bucket) — the Khomenko-style request stream means a
+        # bucket-ladder regression must be visible per bucket, not
+        # blended away — plus the SLO rings and the drift sketch (the
+        # served scores are already host arrays; nothing here touches
+        # the device).
+        if metrics.enabled():
+            m = metrics.METRICS
+            # One label-set resolution per BATCH, then bare records —
+            # and no numpy anywhere in this block: numpy calls release
+            # the GIL, and a GIL release on this thread under
+            # closed-loop contention costs a scheduling quantum.
+            hist = m.histogram("serve_latency_ms",
+                               universe=universe, width=width)
+            for lat in lats:
+                hist.record(lat)
+            m.mark("serve_ok", float(len(batch)))
+            slo_ms = metrics.slo_p99_ms_default()
+            if slo_ms > 0:
+                bad = sum(1 for lat in lats if lat > slo_ms)
+                if bad:
+                    m.mark("serve_slo_lat_bad", float(bad))
+            # The response-path copies, not views of `out`: a lazy
+            # sketch entry pins its base array until a fold, and 256
+            # pending views of full (rows × width) batch outputs is
+            # tens of MB at large width. The fold re-copies to f64, so
+            # sharing with the (read-mostly) client response is safe.
+            entry.record_scores(score_slices)
         telemetry.COUNTERS.bump("serve_batches")
         telemetry.COUNTERS.bump("serve_rows", rows)
         telemetry.COUNTERS.bump("serve_rows_real", len(batch))
@@ -517,6 +556,20 @@ class MicroBatcher:
             self._requests += len(batch)
 
     # ---- stats / health / lifecycle ----------------------------------
+
+    def queue_depth(self) -> int:
+        """Current queue depth (gauge read: a single ``len`` is
+        GIL-atomic; staleness by one in-flight submit is the documented
+        worst case for cross-thread gauge readers)."""
+        return len(self._queue)
+
+    def circuit_state_code(self) -> int:
+        """The ``circuit_state`` gauge encoding (DESIGN.md §18 +
+        §19): 0 closed, 1 half-open, 2 open, 3 batcher dead."""
+        if self._dead is not None:
+            return 3
+        return {"closed": 0, "half_open": 1, "open": 2}.get(
+            self._circuit, 0)
 
     def health(self) -> Dict[str, Any]:
         """Readiness, with the reason when degraded: a dead batcher

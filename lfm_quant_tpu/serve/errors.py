@@ -88,6 +88,29 @@ class BatcherDeadError(ServeError):
         self.cause = cause
 
 
+class DriftVetoError(ServeError):
+    """The knob-gated publish veto (``LFM_DRIFT_GATE=1``, DESIGN.md
+    §19): the universe's served-score distribution has drifted past
+    ``LFM_DRIFT_MAX`` from its publish-time reference sketch, so the
+    next atomic publish is BLOCKED until the operator re-validates (or
+    overrides with the gate off) — the first concrete piece of the
+    ROADMAP 5b risk gate. HTTP 409: the request conflicts with the
+    service's current (drifted) state, it is not a service outage."""
+
+    http_status = 409
+
+    def __init__(self, universe: str, psi: float, threshold: float):
+        super().__init__(
+            f"publish vetoed for universe {universe!r}: served-score "
+            f"drift PSI {psi:.4f} exceeds LFM_DRIFT_MAX {threshold:g} "
+            "against the serving generation's reference sketch — "
+            "re-validate the universe (or disable LFM_DRIFT_GATE) "
+            "before publishing the next generation")
+        self.universe = universe
+        self.psi = float(psi)
+        self.threshold = float(threshold)
+
+
 #: Runtime status substrings worth a bounded retry (XLA/PJRT transient
 #: status codes surface as RuntimeError text on this jax version).
 _TRANSIENT_TOKENS = ("RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED",

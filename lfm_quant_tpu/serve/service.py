@@ -24,6 +24,7 @@ dispatch would delete them under in-flight scoring traffic.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
@@ -31,8 +32,9 @@ import numpy as np
 
 from lfm_quant_tpu.serve import buckets
 from lfm_quant_tpu.serve.batcher import MicroBatcher, ScoreResponse
+from lfm_quant_tpu.serve.monitor import ServiceMonitor
 from lfm_quant_tpu.serve.zoo import ModelZoo, ZooEntry
-from lfm_quant_tpu.utils import telemetry
+from lfm_quant_tpu.utils import metrics, telemetry
 
 
 class ScoringService:
@@ -56,6 +58,7 @@ class ScoringService:
             queue_max=queue_max, deadline_ms=deadline_ms, retries=retries,
             breaker_threshold=breaker_threshold,
             breaker_cooldown_ms=breaker_cooldown_ms)
+        self.monitor = ServiceMonitor(self)
         self._refresh_lock = threading.Lock()
 
     # ---- registration / warmup --------------------------------------
@@ -73,11 +76,19 @@ class ScoringService:
             gen = donor.generation + 1
         except KeyError:
             gen = 0
+        # The knob-gated drift veto (LFM_DRIFT_GATE, DESIGN.md §19):
+        # it only reads the CURRENT generation's sketches, so check
+        # before paying the warmup compile ladder and the reference
+        # batch-scoring for an entry a veto would discard — and before
+        # the swap, so a vetoed publish leaves the served generation
+        # untouched and still serving.
+        self.monitor.check_publish_gate(universe)
         entry = ZooEntry(universe, gen, trainer)
         if donor is not None:
             entry.adopt_programs(donor)
         if warm:
             self.warmup_entry(entry)
+            self._stamp_reference(entry)
         self.zoo.publish(entry)
         return entry
 
@@ -107,6 +118,77 @@ class ScoringService:
                         np.asarray(entry.programs_for((rows, width))(
                             entry.params, dev, fi, ti, w))
         return len(widths) * len(ladder)
+
+    #: Cap on the months batch-scored for a publish-time reference
+    #: sketch (evenly spread across the serveable range): enough mass
+    #: for a stable 16-bin distribution at bounded publish cost.
+    REFERENCE_MONTH_CAP = 32
+
+    def _stamp_reference(self, entry: ZooEntry) -> None:
+        """Score-drift reference at publish (DESIGN.md §19): batch-score
+        an even spread of the entry's serveable months through its
+        WARMED bucket programs — every (rows, width) dispatched here is
+        a warmup-ladder member, so this adds ZERO jit traces and ZERO
+        panel H2D — and stamp the resulting distribution sketch
+        (moments + fixed-edge histogram) into the entry. Served scores
+        then stream into the live twin (batcher) and the monitor's PSI
+        gauge compares the two. Exact no-op when ``LFM_METRICS=0`` or
+        drift evaluation is disabled (``LFM_DRIFT_MAX <= 0``)."""
+        if not (metrics.enabled() and metrics.drift_max_default() > 0):
+            return
+        cols = sorted(entry._month_index.values())
+        if not cols:
+            return
+        cap = self.REFERENCE_MONTH_CAP
+        if len(cols) > cap:
+            step = (len(cols) - 1) / (cap - 1)
+            cols = sorted({cols[int(round(i * step))] for i in range(cap)})
+        by_width: Dict[int, List[Any]] = {}
+        for t in cols:
+            pool = entry.pool(t)
+            if pool.size == 0:
+                continue
+            by_width.setdefault(
+                buckets.bucket_width(pool.size), []).append((t, pool))
+        chunk_scores: List[np.ndarray] = []
+        with telemetry.span("drift_reference", cat="serve",
+                            universe=entry.universe,
+                            generation=entry.generation,
+                            months=sum(len(v) for v in by_width.values())):
+            with entry.lease_panel() as dev:
+                for width, items in sorted(by_width.items()):
+                    for k in range(0, len(items), self.max_rows):
+                        chunk = items[k:k + self.max_rows]
+                        rows = buckets.bucket_rows(len(chunk),
+                                                   self.max_rows)
+                        fi = np.zeros((rows, width), np.int32)
+                        ti = np.zeros((rows,), np.int32)
+                        w = np.zeros((rows, width), np.float32)
+                        for i, (t, pool) in enumerate(chunk):
+                            fi[i, :pool.size] = pool
+                            fi[i, pool.size:] = pool[-1]
+                            ti[i] = t
+                            w[i, :pool.size] = 1.0
+                        for i in range(len(chunk), rows):
+                            fi[i], ti[i] = fi[0], ti[0]
+                        out = np.asarray(
+                            entry.programs_for((rows, width))(
+                                entry.params, dev, fi, ti, w))
+                        for i, (_, pool) in enumerate(chunk):
+                            chunk_scores.append(out[i, :pool.size])
+        if not chunk_scores:
+            return
+        try:
+            entry.stamp_reference(metrics.ScoreSketch.reference(
+                np.concatenate(chunk_scores)))
+        except ValueError:
+            import warnings
+
+            warnings.warn(
+                f"universe {entry.universe!r} gen {entry.generation}: "
+                "no finite batch scores — drift reference not stamped "
+                "(the drift gauge stays inactive for this generation)",
+                RuntimeWarning, stacklevel=2)
 
     # ---- query path --------------------------------------------------
 
@@ -154,6 +236,11 @@ class ScoringService:
 
         with self._refresh_lock:
             cur = self.zoo.current(universe)
+            # Drift veto BEFORE the retrain (it only reads the served
+            # generation's sketches): a vetoed refresh must not pay a
+            # whole warm fit plus the warmup ladder for an entry it
+            # then discards.
+            self.monitor.check_publish_gate(universe)
             cfg = cur.cfg
             if epochs is not None:
                 cfg = dataclasses.replace(
@@ -178,33 +265,80 @@ class ScoringService:
                 entry = ZooEntry(universe, cur.generation + 1, trainer)
                 entry.adopt_programs(cur)
                 self.warmup_entry(entry)
+                self._stamp_reference(entry)
                 self.zoo.publish(entry)
             return entry
 
     # ---- observability / lifecycle -----------------------------------
 
+    def snapshot(self) -> Dict[str, Any]:
+        """ONE consistent observability snapshot per caller: ``{ts,
+        stats, health}``, each sub-view built from a single locked read
+        of the structure that owns it (``batcher.stats()`` under one
+        stats lock, ``zoo.snapshot()`` under one zoo lock). The
+        pre-metrics ``/stats`` and ``/healthz`` handlers re-derived
+        state per field (``zoo.universes()`` then ``generation(u)`` per
+        universe — each its own lock acquisition), so a handler racing
+        a refresh/breaker transition could report a TORN view; both
+        endpoints now share one call to this, and both carry the same
+        scrape timestamp.
+
+        ``p50_ms``/``p99_ms`` in ``stats`` come from the same
+        per-request ``latency_ms`` values the ``serve_request`` spans
+        carry, so ``scripts/trace_report.py`` reproduces them exactly
+        from a run dir (the bench cross-check contract)."""
+        ts = time.time()
+        stats = self.batcher.stats()
+        zsnap = self.zoo.snapshot()
+        stats["ts"] = ts
+        stats["universes"] = zsnap["universes"]
+        stats["zoo_size"] = zsnap["size"]
+        stats["zoo_capacity"] = zsnap["capacity"]
+        health = self.batcher.health()
+        health["ts"] = ts
+        health["zoo_size"] = zsnap["size"]
+        if metrics.enabled():
+            # SLO / drift DETAIL (DESIGN.md §19): a burning SLO or a
+            # drifted universe is an operator alert surfaced here;
+            # readiness (the 503 path) stays owned by the batcher/
+            # breaker machinery above.
+            from lfm_quant_tpu.serve.monitor import slo_status
+
+            slo = slo_status()
+            drift = self.monitor.drift_status()
+            health["slo"] = {"burning": slo["burning"],
+                             "max_burn": slo["max_burn"],
+                             "objectives": slo["objectives"]}
+            health["drift"] = {"breached": drift["breached"],
+                               "threshold": drift["threshold"],
+                               "universes": drift["universes"]}
+        return {"ts": ts, "stats": stats, "health": health}
+
     def stats(self) -> Dict[str, Any]:
-        """The serving rollup: batcher latency/occupancy plus zoo state.
-        ``p50_ms``/``p99_ms`` come from the same per-request
-        ``latency_ms`` values the ``serve_request`` spans carry, so
-        ``scripts/trace_report.py`` reproduces them exactly from a run
-        dir (the bench cross-check contract)."""
-        out = self.batcher.stats()
-        out["universes"] = {
-            u: self.zoo.generation(u) for u in self.zoo.universes()}
-        out["zoo_size"] = len(self.zoo)
-        out["zoo_capacity"] = self.zoo.capacity
-        return out
+        """The serving rollup (one consistent :meth:`snapshot` view)."""
+        return self.snapshot()["stats"]
 
     def health(self) -> Dict[str, Any]:
         """REAL readiness (the /healthz contract, DESIGN.md §18): not
         ready — with the reason — when the batcher thread is dead or
         the circuit breaker is open; ``retry_after_s`` carries the
-        remaining breaker cooldown. The pre-chaos endpoint returned a
-        constant ``{"ok": true}`` even with the batcher thread dead."""
-        h = self.batcher.health()
-        h["zoo_size"] = len(self.zoo)
-        return h
+        remaining breaker cooldown. Carries the SLO-burn and
+        score-drift DETAIL (DESIGN.md §19) without flipping ``ok`` —
+        those are operator alerts, not routing decisions."""
+        return self.snapshot()["health"]
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """The live metrics plane as JSON: gauges collected, every
+        instrument summarized, SLO burn and drift status attached
+        (``serve/monitor.py``). The Prometheus text twin is
+        :meth:`metrics_text`."""
+        return self.monitor.snapshot()
+
+    def metrics_text(self, ts: Optional[float] = None) -> str:
+        """The ``GET /metrics`` exposition document (Prometheus text
+        format 0.0.4): the instrument registry plus the absorbed
+        ``telemetry.COUNTERS``. Pure host-side string building."""
+        return self.monitor.metrics_text(ts=ts)
 
     def close(self) -> None:
         self.batcher.close()

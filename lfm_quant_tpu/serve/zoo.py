@@ -124,6 +124,13 @@ class ZooEntry:
         # busy cache evicts the serve keys (evicted bundles keep working
         # for holders of a reference — train/reuse.py contract).
         self._programs: Dict[BucketKey, ServePrograms] = {}
+        # Score-drift sketches (utils/metrics.py ScoreSketch, DESIGN.md
+        # §19): the REFERENCE is stamped at publish from the
+        # generation's batch-scored months; the LIVE twin streams from
+        # served responses. None until the service stamps them (metrics
+        # off, or a pre-metrics register path).
+        self.ref_sketch = None
+        self.live_sketch = None
         # Zoo bookkeeping (guarded by the zoo's lock).
         self.refs = 0
         self.doomed = False
@@ -202,6 +209,70 @@ class ZooEntry:
                 reuse.serve_program_key(self.trainer.program_key, bucket),
                 lambda sp=sp: sp)
 
+    # ---- score-drift sketches (DESIGN.md §19) ------------------------
+
+    def stamp_reference(self, sketch) -> None:
+        """Attach the publish-time reference sketch and its empty live
+        twin (same bin edges, so the two are always comparable)."""
+        self.ref_sketch = sketch
+        self.live_sketch = sketch.live_twin()
+
+    def record_scores(self, scores) -> None:
+        """Stream served scores into the live sketch: the batcher's
+        per-dispatch call, O(1) on its critical path (lazy appends —
+        readers fold; a numpy histogram here would release the GIL
+        mid-batch and measurably tax closed-loop throughput).
+        ``scores`` is one array or a list of per-request arrays. Exact
+        no-op when no reference was stamped or ``LFM_METRICS=0``."""
+        from lfm_quant_tpu.utils import metrics
+
+        if self.live_sketch is None or not metrics.enabled():
+            return
+        if isinstance(scores, (list, tuple)):
+            for a in scores:
+                self.live_sketch.record_lazy(a)
+        else:
+            self.live_sketch.record_lazy(scores)
+
+    def drift_psi(self, min_scores: int = 1):
+        """PSI of the live served-score distribution against the
+        publish-time reference; None until sketches exist and the live
+        one holds at least ``min_scores`` scores."""
+        if self.ref_sketch is None or self.live_sketch is None:
+            return None
+        if self.live_sketch.size() < max(1, int(min_scores)):
+            return None
+        return self.ref_sketch.psi(self.live_sketch)
+
+    # ---- resident-footprint metadata ---------------------------------
+
+    def param_bytes(self) -> int:
+        """Resident parameter bytes from array METADATA (shape × dtype
+        — jax exposes ``nbytes`` without a device fetch; the metrics
+        path must never originate one)."""
+        import jax
+
+        return int(sum(getattr(leaf, "nbytes", 0)
+                       for leaf in jax.tree.leaves(self.trainer.state)))
+
+    def panel_bytes(self) -> int:
+        """Resident panel bytes at the entry's compute dtype: host-side
+        array sizes with features scaled by the lane's itemsize (the
+        bf16 lane halves the feature block — DESIGN.md §17); masks/
+        targets/returns ride at their host width."""
+        p = self.panel
+        feat = int(p.features.nbytes)
+        if self._compute_dtype is not None:
+            import numpy as np
+
+            factor = (np.dtype(self._compute_dtype).itemsize
+                      / p.features.dtype.itemsize)
+            feat = int(feat * factor)
+        aux = sum(int(a.nbytes) for a in
+                  (p.targets, p.valid, p.target_valid, p.returns)
+                  if getattr(a, "nbytes", None) is not None)
+        return feat + aux
+
     @property
     def params(self):
         return self.trainer.state.params
@@ -232,6 +303,21 @@ class ModelZoo:
 
     def generation(self, universe: str) -> int:
         return self.current(universe).generation
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One-lock routing-table snapshot: ``{universes: {name: gen},
+        size, capacity}``. The per-field accessors above each take the
+        lock separately, so a caller iterating them can observe a TORN
+        view across a concurrent publish/eviction — consumers that
+        report state (``ScoringService.snapshot()``, the monitor's
+        gauge collection) read through here instead."""
+        with self._lock:
+            return {
+                "universes": {u: e.generation
+                              for u, e in self._entries.items()},
+                "size": len(self._entries),
+                "capacity": self.capacity,
+            }
 
     def __len__(self) -> int:
         return len(self._entries)

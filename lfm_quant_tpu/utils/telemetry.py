@@ -462,6 +462,9 @@ _KNOB_PROBES = (
     # in the manifest's config block. scripts/check_knobs.py pins that
     # every probed knob here resolves.
     ("precision", "lfm_quant_tpu.config", "resolve_precision"),
+    # Live metrics plane (LFM_METRICS, DESIGN.md §19): whether the
+    # always-on instruments record at all (the /metrics kill switch).
+    ("metrics", "lfm_quant_tpu.utils.metrics", "enabled"),
 )
 
 

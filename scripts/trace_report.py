@@ -53,6 +53,100 @@ def _pctl(values: List[float], q: float) -> Optional[float]:
     return float(v[f] * (c - k) + v[c] * (k - f))
 
 
+def _parse_prom(text: str) -> Dict[str, List[Any]]:
+    """Parse a Prometheus text scrape into name → [(labels, value)] —
+    VERBATIM twin of ``lfm_quant_tpu/utils/metrics.py
+    parse_prometheus`` (this script must stay importable with no
+    package dependency; the metrics test lane cross-checks the two on
+    the same scrape, the percentile-twin discipline applied to
+    parsing)."""
+    out: Dict[str, List[Any]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            head, _, val = line.rpartition(" ")
+            if "{" in head:
+                name, _, rest = head.partition("{")
+                body = rest.rsplit("}", 1)[0]
+                labels: Dict[str, str] = {}
+                for part in body.split(","):
+                    if not part:
+                        continue
+                    k, _, v = part.partition("=")
+                    labels[k.strip()] = v.strip().strip('"')
+            else:
+                name, labels = head, {}
+            v = float("inf") if val == "+Inf" else float(val)
+            out.setdefault(name.strip(), []).append((labels, v))
+        except ValueError:
+            continue  # never die on a foreign exposition line
+    return out
+
+
+def _prom_hist_quantile(pairs: List[Any], q: float) -> Optional[float]:
+    """Estimated quantile from CUMULATIVE ``(le, count)`` pairs —
+    VERBATIM twin of ``utils/metrics.py hist_quantile_from_buckets``
+    (same rank rule and in-bucket interpolation as the in-process
+    ``LogHistogram.quantile``, so scrape-side estimates can never
+    silently drift from the live ones)."""
+    if not pairs:
+        return None
+    pairs = sorted(pairs, key=lambda p: p[0])
+    total = pairs[-1][1]
+    if total <= 0:
+        return None
+    rank = (total - 1) * q / 100.0
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in pairs:
+        if cum > rank and cum > prev_cum:
+            if not math.isfinite(le):
+                return float(prev_le)  # overflow bucket: clamp
+            c = cum - prev_cum
+            frac = (rank - prev_cum + 0.5) / c
+            return float(prev_le + (le - prev_le)
+                         * min(max(frac, 0.0), 1.0))
+        if math.isfinite(le):
+            prev_le, prev_cum = le, max(prev_cum, cum)
+    return float(prev_le)
+
+
+def _merged_hist_pairs(entries: List[Any]) -> List[Any]:
+    """Merge per-label-set cumulative bucket series into one cumulative
+    ladder. Series truncate at their own last non-empty bucket (the
+    exposition elides trailing zeros), so a plain per-``le`` sum would
+    go NON-MONOTONE where a short series stops; instead each series
+    contributes its cumulative value at the largest emitted bound <=
+    the target ``le`` (== its total once past its last bucket)."""
+    series: Dict[Any, List[Any]] = {}
+    les: set = set()
+    for labels, v in entries:
+        le_s = labels.get("le", "")
+        le = float("inf") if le_s in ("+Inf", "inf") else float(le_s)
+        key = tuple(sorted((k, s) for k, s in labels.items()
+                           if k != "le"))
+        series.setdefault(key, []).append((le, v))
+        if math.isfinite(le):
+            les.add(le)
+    for pairs in series.values():
+        pairs.sort(key=lambda p: p[0])
+
+    def cum_at(pairs: List[Any], le: float) -> float:
+        best = 0.0
+        for b, v in pairs:
+            if b <= le or not math.isfinite(b) and le == math.inf:
+                best = max(best, v)
+        return best
+
+    out = [(le, sum(cum_at(p, le) for p in series.values()))
+           for le in sorted(les)]
+    total = sum(max((v for _, v in p), default=0.0)
+                for p in series.values())
+    out.append((math.inf, total))
+    return out
+
+
 def _read_jsonl(path: str) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     if not os.path.exists(path):
@@ -81,11 +175,24 @@ def load_run(run_dir: str) -> Dict[str, Any]:
             manifest = None
     import glob
 
+    # A saved /metrics scrape (serve.py --run-dir and bench.py serve
+    # write one as metrics.prom) — the live metrics plane's text
+    # document, cross-checked against the span-derived numbers below.
+    metrics_text = None
+    for p in sorted(glob.glob(os.path.join(run_dir, "metrics*.prom"))):
+        try:
+            with open(p) as fh:
+                metrics_text = fh.read()
+            break
+        except OSError:
+            continue
+
     return {
         "run_dir": run_dir,
         "manifest": manifest,
         "spans": _read_jsonl(os.path.join(run_dir, "spans.jsonl")),
         "ledger": _read_jsonl(os.path.join(run_dir, "ledger.jsonl")),
+        "metrics_text": metrics_text,
         # First process owns trace.json; later ones (backtest over a
         # train dir) land as trace.<pid>.json — count them all.
         "trace_files": sorted(
@@ -333,6 +440,87 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
             "faults_injected": int(
                 counters.get("faults_injected", 0) or 0),
         }
+    # Live-metrics cross-check (the /metrics scrape vs the spans — the
+    # pull-side plane and the post-hoc plane must tell the same story):
+    # served-request count and degradation totals within 1%, the
+    # histogram-estimated p99 within one bucket's relative resolution
+    # of the exact span-derived percentile (the log-spaced sketch's
+    # documented error bound — utils/metrics.py LogHistogram).
+    if run.get("metrics_text"):
+        prom = _parse_prom(run["metrics_text"])
+        hist_counts = prom.get("lfm_serve_latency_ms_count", [])
+        bucket_entries = prom.get("lfm_serve_latency_ms_bucket", [])
+        pairs = _merged_hist_pairs(bucket_entries) if bucket_entries \
+            else []
+        fin = [le for le, _ in pairs if math.isfinite(le)]
+        # The ladder's growth factor, recovered from the scrape itself:
+        # one bucket's relative width is the quantile error bound.
+        rel_res = (fin[1] / fin[0] - 1.0) if len(fin) >= 2 else 0.5
+
+        def _total(name: str) -> Optional[int]:
+            vals = prom.get(name)
+            return int(sum(v for _, v in vals)) if vals else None
+
+        msec: Dict[str, Any] = {
+            "requests": int(sum(v for _, v in hist_counts)),
+            "p50_ms": _prom_hist_quantile(pairs, 50.0),
+            "p99_ms": _prom_hist_quantile(pairs, 99.0),
+            "rel_resolution": round(rel_res, 4),
+            "shed": _total("lfm_serve_shed_total"),
+            "deadline_drops": _total("lfm_serve_deadline_drops_total"),
+            "retries": _total("lfm_serve_retries_total"),
+            "breaker_opens": _total("lfm_serve_breaker_opens_total"),
+            "drift_psi": {
+                tuple(sorted(lab.items())): v
+                for lab, v in prom.get("lfm_score_drift_psi", [])} or None,
+            "slo_burn": next((v for _, v in prom.get("lfm_slo_burn", [])),
+                             None),
+        }
+        msec["drift_psi"] = (
+            {"/".join(f"{k}={v}" for k, v in key): val
+             for key, val in msec["drift_psi"].items()}
+            if msec["drift_psi"] else None)
+        mismatches: List[str] = []
+        sv = report.get("serve")
+        if sv:
+            def _count_mismatch(name: str, scraped, spans_v) -> None:
+                if scraped is None or spans_v is None:
+                    return
+                tol = max(1.0, 0.01 * abs(spans_v))  # the 1% contract
+                if abs(scraped - spans_v) > tol:
+                    mismatches.append(
+                        f"{name}: scrape {scraped} vs spans {spans_v} "
+                        "(>1% apart — the live plane and the span "
+                        "record disagree)")
+
+            _count_mismatch("requests", msec["requests"],
+                            sv.get("completed"))
+            for k in ("shed", "deadline_drops", "retries",
+                      "breaker_opens"):
+                _count_mismatch(k, msec[k], sv.get(k))
+            # p99: the scrape-side estimate interpolates WITHIN the
+            # bucket covering the rank, while the span percentile
+            # interpolates BETWEEN order statistics — on small/outlier
+            # streams those differ legitimately. The RIGOROUS invariant
+            # (holds for any distribution when the two cover the same
+            # stream): the estimate lies within one bucket factor of
+            # the rank's order statistic in the span latencies.
+            span_lat = sorted(
+                s["args"]["latency_ms"] for s in spans
+                if s.get("name") == "serve_request"
+                and "latency_ms" in s.get("args", {}))
+            mp99 = msec["p99_ms"]
+            if span_lat and mp99:
+                anchor = span_lat[int((len(span_lat) - 1) * 0.99)]
+                g = 1.0 + rel_res
+                if not (anchor / g - 0.01 <= mp99 <= anchor * g + 0.01):
+                    mismatches.append(
+                        f"p99_ms: scrape estimate {mp99:.3f} outside "
+                        f"one bucket of the span stream's p99-rank "
+                        f"order statistic {anchor:.3f} (×{g:.3f})")
+        msec["mismatches"] = mismatches
+        report["metrics"] = msec
+
     m = run["manifest"]
     if m:
         jx = m.get("jax") if isinstance(m.get("jax"), dict) else {}
@@ -443,6 +631,16 @@ def print_report(rep: Dict[str, Any]) -> None:
                   f"retries {sv.get('retries', 0)}  "
                   f"breaker_opens {sv.get('breaker_opens', 0)}  "
                   f"faults_injected {sv.get('faults_injected', 0)}")
+    mx = rep.get("metrics")
+    if mx:
+        p99 = mx.get("p99_ms")
+        print(f"metrics     : scrape requests={mx.get('requests')}  "
+              f"p99~{p99 if p99 is None else f'{p99:.2f}'}ms "
+              f"(±{100 * mx.get('rel_resolution', 0):.0f}% bucket "
+              f"resolution)  slo_burn={mx.get('slo_burn')}  "
+              f"drift={mx.get('drift_psi') or '-'}")
+        for msg in mx.get("mismatches") or []:
+            print(f"  METRICS MISMATCH: {msg}")
     print(f"host syncs  : {rep['host_syncs']} "
           f"({rep['syncs_per_epoch']}/epoch, {rep['host_sync_s']:.3f}s "
           f"blocked)" if rep["syncs_per_epoch"] is not None else

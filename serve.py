@@ -17,8 +17,18 @@ rollup. With ``--http PORT`` it additionally exposes the service on a
 stdlib JSON endpoint until interrupted:
 
     GET /score?universe=u0&month=199001   → scores for the month
-    GET /stats                            → the stats() rollup
+    GET /stats                            → the stats() rollup (+ts)
     GET /healthz                          → 200 ok | 503 + reason
+                                            (+ SLO-burn/drift detail)
+    GET /metrics                          → Prometheus text exposition
+                                            (live histograms, rates,
+                                            gauges, counters — §19)
+
+``/stats`` and ``/healthz`` share ONE ``service.snapshot()`` call per
+request (single locked read per owning structure, same scrape ``ts`` in
+both) instead of re-deriving state per field — the pre-metrics handlers
+could observe a torn view across a concurrent refresh/breaker
+transition.
 
 Failure semantics (the degradation layer, DESIGN.md §18 — mapping in
 lfm_quant_tpu/serve/errors.py, pinned by tests/test_chaos.py):
@@ -151,19 +161,43 @@ def run_http(service, port: int):
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, text: str, content_type: str):
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
             url = urlparse(self.path)
             try:
-                if url.path == "/healthz":
+                if url.path in ("/healthz", "/stats"):
+                    # ONE snapshot call per request (DESIGN.md §19):
+                    # both views derive from the same locked reads and
+                    # carry the same scrape ts — no torn state across a
+                    # concurrent refresh/breaker transition, no
+                    # per-field re-derivation.
+                    snap = service.snapshot()
+                    if url.path == "/stats":
+                        return self._send(200, snap["stats"])
                     # REAL readiness (DESIGN.md §18): 503 + reason when
                     # the batcher is dead or the circuit is open — a
                     # load balancer must stop routing here, which the
-                    # old constant {"ok": true} prevented.
-                    h = service.health()
+                    # old constant {"ok": true} prevented. SLO-burn and
+                    # score-drift DETAIL rides along (§19) without
+                    # flipping ok.
+                    h = snap["health"]
                     return self._send(200 if h.get("ok") else 503, h,
                                       retry_after_s=h.get("retry_after_s"))
-                if url.path == "/stats":
-                    return self._send(200, service.stats())
+                if url.path == "/metrics":
+                    # Prometheus text exposition (utils/metrics.py §19):
+                    # live histograms/rates/gauges plus the absorbed
+                    # telemetry counters. text/plain; version=0.0.4 is
+                    # the format's registered content type.
+                    return self._send_text(
+                        200, service.metrics_text(),
+                        "text/plain; version=0.0.4; charset=utf-8")
                 if url.path == "/score":
                     q = parse_qs(url.query)
                     r = service.score(q["universe"][0],
@@ -257,6 +291,15 @@ def main(argv=None) -> int:
         for e in errors[:5]:
             print(f"[serve] ERROR {e}", file=sys.stderr)
         if args.run_dir:
+            # Save the final /metrics scrape beside the spans so
+            # scripts/trace_report.py can cross-check the live metrics
+            # plane against the span-derived numbers (its `metrics`
+            # section — same 1% contract as the stats() twins).
+            import os
+
+            with open(os.path.join(args.run_dir, "metrics.prom"),
+                      "w") as fh:
+                fh.write(service.metrics_text())
             print(f"[serve] telemetry in {args.run_dir} — "
                   f"python scripts/trace_report.py {args.run_dir}")
         try:

@@ -1,0 +1,583 @@
+"""Live metrics plane (utils/metrics.py + serve/monitor.py): the
+``metrics`` lane (DESIGN.md §19).
+
+What is pinned, measured not hoped:
+
+* the instruments are EXACT where they claim exactness (multi-threaded
+  hammer: total count / sum / per-bucket counts) and BOUNDED where they
+  estimate (histogram p50/p99 vs the exact ``serve/stats.py
+  percentile`` twins, within one bucket's relative resolution);
+* the Prometheus exposition round-trips through the parse/quantile
+  twins (``utils/metrics.py`` == ``scripts/trace_report.py``) on the
+  same document;
+* the SLO burn rates evaluate multi-window over the rings with
+  deterministic injected clocks; the drift gauge fires on a forged
+  N(0,1) → N(0.5,1) shift and stays quiet on identical streams; the
+  knob-gated ``LFM_DRIFT_GATE`` veto blocks the atomic publish;
+* ``/stats`` and ``/healthz`` share ONE snapshot (same scrape ts);
+* ``scripts/trace_report.py``'s metrics section cross-checks a saved
+  scrape against the span-derived numbers (1% / one-bucket contract)
+  and goes LOUD on a forged scrape;
+* NON-INTERFERENCE is MEASURED: with ``LFM_METRICS=1`` a warm fit pays
+  zero jit traces / zero panel H2D / one host sync per epoch, serving
+  steady state pays zero traces / zero panel H2D, scraping adds zero
+  device work, and ``LFM_METRICS=0`` is an exact no-op.
+
+Module named early in the alphabet on purpose: it must sort before the
+tier-1 timebox cut at ``test_ring.py`` (ROADMAP tier-1 notes).
+"""
+
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.data.windows import clear_panel_cache
+from lfm_quant_tpu.serve import ScoringService
+from lfm_quant_tpu.serve.errors import DriftVetoError
+from lfm_quant_tpu.serve.stats import load_trace_report, percentile
+from lfm_quant_tpu.serve import monitor
+from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.train.loop import Trainer
+from lfm_quant_tpu.utils import metrics, telemetry
+from lfm_quant_tpu.utils.metrics import (
+    METRICS,
+    LogHistogram,
+    MetricsRegistry,
+    ScoreSketch,
+    WindowedRing,
+)
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+pytestmark = pytest.mark.metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _metrics_hygiene(monkeypatch):
+    """Fresh instrument registry and default knob state in AND out, so
+    a failing metrics test can never poison its neighbors (the chaos
+    lane's hygiene pattern)."""
+    for knob in ("LFM_METRICS", "LFM_SLO_P99_MS", "LFM_SLO_AVAIL",
+                 "LFM_DRIFT_MAX", "LFM_DRIFT_GATE"):
+        monkeypatch.delenv(knob, raising=False)
+    METRICS.reset()
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    yield
+    METRICS.reset()
+    reuse.clear_program_cache()
+    clear_panel_cache()
+
+
+def _cfg(n_firms=60, window=8, seed=0, epochs=1, name="metrics_t"):
+    return RunConfig(
+        name=name,
+        data=DataConfig(n_firms=n_firms, n_months=160, n_features=5,
+                        window=window, dates_per_batch=4,
+                        firms_per_date=32),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (16,)}),
+        optim=OptimConfig(lr=1e-3, epochs=epochs, warmup_steps=2,
+                          loss="mse"),
+        seed=seed,
+    )
+
+
+def _universe(n_firms=60, window=8, seed=0, panel_seed=3):
+    panel = synthetic_panel(n_firms=n_firms, n_months=160, n_features=5,
+                            seed=panel_seed)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    tr = Trainer(_cfg(n_firms=n_firms, window=window, seed=seed), splits)
+    tr.state = tr.init_state()
+    return tr
+
+
+@pytest.fixture()
+def service():
+    svc = ScoringService(max_rows=4, max_wait_ms=1.0)
+    yield svc
+    svc.close()
+
+
+# ---- instruments ---------------------------------------------------------
+
+
+def test_log_histogram_exact_totals_and_bounds():
+    h = LogHistogram(lo=1e-2, hi=1e5, buckets_per_decade=20)
+    vals = [0.005, 0.01, 1.0, 99.0, 1e5, 2e5, 7.3]
+    for v in vals:
+        h.record(v)
+    assert h.count == len(vals)
+    assert h.sum == pytest.approx(sum(vals))
+    assert h.vmin == 0.005 and h.vmax == 2e5
+    # Underflow (<= lo) and overflow (> hi) land in their edge buckets.
+    assert h._counts[0] == 2          # 0.005 and the lo boundary itself
+    assert h._counts[-1] == 1         # 2e5 > hi
+    # Bucket upper bounds are inclusive (the Prometheus `le` rule).
+    i = h._index(1.0)
+    assert h.upper_bound(i) >= 1.0 > h.upper_bound(i - 1)
+    snap = h.snapshot()
+    assert snap["count"] == len(vals) and snap["max"] == 2e5
+
+
+def test_log_histogram_quantiles_pin_percentile_twin():
+    """Satellite pin: histogram-estimated p50/p99 vs the exact
+    ``serve/stats.py percentile`` on the same stream, within one
+    bucket's relative resolution — the sketch can never silently drift
+    from the numbers stats()/trace_report report."""
+    rng = np.random.default_rng(7)
+    h = LogHistogram()
+    vals = list(rng.lognormal(mean=2.5, sigma=0.9, size=8000))
+    for v in vals:
+        h.record(v)
+    for q in (50.0, 90.0, 99.0):
+        exact = percentile(vals, q)
+        est = h.quantile(q)
+        assert abs(est - exact) / exact <= h.rel_resolution, (
+            f"q={q}: histogram {est} vs exact {exact} beyond the "
+            f"one-bucket bound {h.rel_resolution:.4f}")
+    # Degenerate stream: all-equal values estimate EXACTLY (min/max
+    # clamp), not merely within a bucket.
+    h2 = LogHistogram()
+    for _ in range(100):
+        h2.record(42.0)
+    assert h2.quantile(50.0) == 42.0 and h2.quantile(99.0) == 42.0
+
+
+def test_log_histogram_merge_same_geometry_only():
+    a, b = LogHistogram(), LogHistogram()
+    for v in (1.0, 10.0):
+        a.record(v)
+    for v in (5.0, 500.0):
+        b.record(v)
+    a.merge(b)
+    assert a.count == 4 and a.vmax == 500.0
+    assert a.sum == pytest.approx(516.0)
+    with pytest.raises(ValueError, match="geometry"):
+        a.merge(LogHistogram(lo=1e-1))
+
+
+def test_histogram_hammer_threads_exact():
+    """The CounterRegistry hammer applied to the histogram: N threads ×
+    M records, total count / sum / per-bucket counts EXACT — the
+    per-instrument lock loses nothing under contention."""
+    h = LogHistogram()
+    n_threads, m = 8, 4000
+    vals = [float(k + 1) for k in range(n_threads)]  # one value/thread
+
+    def worker(v):
+        for _ in range(m):
+            h.record(v)
+
+    threads = [threading.Thread(target=worker, args=(vals[k],))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n_threads * m
+    assert h.sum == pytest.approx(sum(v * m for v in vals))
+    # Each value's bucket holds exactly its m records (distinct values
+    # may share a bucket — compare per-bucket aggregates).
+    expect = {}
+    for v in vals:
+        expect[h._index(v)] = expect.get(h._index(v), 0) + m
+    for i, c in expect.items():
+        assert h._counts[i] == c
+    assert sum(h._counts) == n_threads * m
+
+
+def test_windowed_ring_totals_rates_and_expiry():
+    r = WindowedRing(ring_s=10.0, rings=30)
+    r.add(1.0, now=5.0)     # ring epoch 0
+    r.add(2.0, now=15.0)    # ring epoch 1
+    r.add(4.0, now=100.0)   # ring epoch 10
+    assert r.total(30.0, now=100.0) == 4.0           # only the newest
+    assert r.total(300.0, now=100.0) == 7.0          # all of them
+    assert r.rate(300.0, now=100.0) == pytest.approx(7.0 / 300.0)
+    # Slot overwrite: 300 s later the same slot is a NEW epoch — the
+    # old value expired by overwrite, no allocation, no leak.
+    r.add(8.0, now=305.0)   # epoch 30 → same slot as epoch 0
+    assert r.total(300.0, now=305.0) == 8.0 + 2.0 + 4.0
+    assert r.span_s == 300.0
+
+
+def test_score_sketch_drift_fires_on_shift_not_on_identical():
+    """The acceptance pin: reference N(0,1) vs served N(0.5,1) crosses
+    LFM_DRIFT_MAX (default 0.2); an identical stream stays well under
+    it. PSI of self is ~0 by construction."""
+    rng = np.random.default_rng(11)
+    ref = ScoreSketch.reference(rng.normal(0.0, 1.0, 8000))
+    assert ref.psi(ref) == pytest.approx(0.0)
+    same = ref.live_twin()
+    same.record(rng.normal(0.0, 1.0, 8000))  # fresh draw, same dist
+    shifted = ref.live_twin()
+    shifted.record(rng.normal(0.5, 1.0, 8000))
+    threshold = metrics.drift_max_default()
+    assert ref.psi(same) < threshold / 2
+    assert ref.psi(shifted) > threshold
+    # Moments track the stream exactly.
+    assert shifted.mean() == pytest.approx(0.5, abs=0.05)
+    assert shifted.std() == pytest.approx(1.0, abs=0.05)
+    # Sketches over different edges refuse to compare.
+    with pytest.raises(ValueError, match="same edges"):
+        ref.psi(ScoreSketch([0.0, 1.0, 2.0]))
+
+
+def test_registry_disabled_is_exact_noop(monkeypatch):
+    """LFM_METRICS=0: every mutator returns on one env read — nothing
+    records, nothing allocates, the snapshot stays empty."""
+    reg = MetricsRegistry()
+    monkeypatch.setenv("LFM_METRICS", "0")
+    assert not metrics.enabled()
+    reg.observe("lat", 5.0, universe="u")
+    reg.mark("ok", 3.0)
+    reg.gauge("depth", 7.0)
+    snap = reg.snapshot()
+    assert snap == {"histograms": {}, "rates_per_sec": {}, "gauges": {}}
+    monkeypatch.delenv("LFM_METRICS")
+    reg.observe("lat", 5.0, universe="u")
+    assert reg.snapshot()["histograms"]["lat{universe=u}"]["count"] == 1
+
+
+# ---- exposition / parse twins --------------------------------------------
+
+
+def _trace_report():
+    return load_trace_report(REPO)
+
+
+def test_prometheus_render_and_parse_twins_agree():
+    """The exposition round-trips, and the scrape-side twins in
+    scripts/trace_report.py (_parse_prom, _prom_hist_quantile) agree
+    VERBATIM with utils/metrics.py on the same document — the
+    percentile-twin discipline applied to parsing."""
+    reg = MetricsRegistry()
+    rng = np.random.default_rng(3)
+    lats = rng.lognormal(2.0, 0.7, 4000)
+    for v in lats:
+        reg.observe("serve_latency_ms", float(v), universe="u0", width=64)
+    for v in lats[:100]:
+        reg.observe("serve_latency_ms", float(v), universe="u1", width=128)
+    reg.mark("serve_ok", 5.0)
+    reg.gauge("zoo_entries", 2.0, shard="a")
+    doc = reg and metrics.render_prometheus(
+        reg, counters={"serve_requests": 4100, "serve_shed": 3,
+                       "not_numeric": "x"}, ts=123.0)
+    tr = _trace_report()
+    parsed_a = metrics.parse_prometheus(doc)
+    parsed_b = tr._parse_prom(doc)
+    assert parsed_a == parsed_b
+    assert ({"universe": "u0", "width": "64"},
+            4000.0) in parsed_a["lfm_serve_latency_ms_count"]
+    assert parsed_a["lfm_serve_requests_total"] == [({}, 4100.0)]
+    assert "lfm_serve_shed_total" in parsed_a
+    assert "not_numeric" not in doc
+    assert parsed_a["lfm_scrape_ts_seconds"] == [({}, 123.0)]
+    assert ({"shard": "a"}, 2.0) in parsed_a["lfm_zoo_entries"]
+    # Histogram quantile twins on the merged bucket ladder.
+    pairs = tr._merged_hist_pairs(parsed_a["lfm_serve_latency_ms_bucket"])
+    assert pairs[-1][1] == 4100.0  # +Inf total across label sets
+    for q in (50.0, 99.0):
+        assert (tr._prom_hist_quantile(pairs, q)
+                == metrics.hist_quantile_from_buckets(pairs, q))
+    # And the estimate still pins the exact percentile of the raw
+    # stream (merged across label sets) within one bucket.
+    all_lats = list(lats) + list(lats[:100])
+    h = reg.merged_histogram("serve_latency_ms")
+    exact = percentile(all_lats, 99.0)
+    assert abs(tr._prom_hist_quantile(pairs, 99.0) - exact) / exact \
+        <= h.rel_resolution
+
+
+def test_prometheus_overflow_bucket_single_inf_line():
+    """A value past the ladder's top lands in the overflow bucket and
+    the exposition still carries exactly ONE le="+Inf" sample per label
+    set (a duplicate series makes Prometheus reject the whole scrape),
+    with _count equal to the +Inf cumulative count."""
+    reg = MetricsRegistry()
+    for v in (1.0, 50.0, 2e5, 9e5):  # two past hi=1e5
+        reg.observe("serve_latency_ms", v, universe="u0", width=8)
+    doc = metrics.render_prometheus(reg, ts=1.0)
+    inf_lines = [ln for ln in doc.splitlines()
+                 if 'le="+Inf"' in ln]
+    assert len(inf_lines) == 1
+    prom = metrics.parse_prometheus(doc)
+    inf_cum = [v for lab, v in prom["lfm_serve_latency_ms_bucket"]
+               if lab["le"] == "+Inf"]
+    assert inf_cum == [4.0]
+    assert prom["lfm_serve_latency_ms_count"] == [
+        ({"universe": "u0", "width": "8"}, 4.0)]
+    # The locked triple is self-consistent (count == +Inf cumulative).
+    pairs, count, _ = reg.histogram(
+        "serve_latency_ms", universe="u0", width=8).prom_snapshot()
+    assert count == pairs[-1][1] == 4
+
+
+# ---- SLO burn rates ------------------------------------------------------
+
+
+def test_slo_burn_rates_multi_window(monkeypatch):
+    """Deterministic clocks through the rings: a sustained breach burns
+    BOTH windows (burning=True); a breach older than the fast window
+    burns only the slow one (burning=False — the multi-window AND)."""
+    monkeypatch.setenv("LFM_SLO_P99_MS", "100")
+    monkeypatch.setenv("LFM_SLO_AVAIL", "0.999")
+    now = 10_000.0
+    METRICS.mark("serve_ok", 1000.0, now=now)
+    METRICS.mark("serve_err", 5.0, now=now)
+    METRICS.mark("serve_slo_lat_bad", 50.0, now=now)
+    s = monitor.slo_status(now=now)
+    assert s["active"] and set(s["objectives"]) == {"availability",
+                                                    "latency_p99"}
+    av = s["objectives"]["availability"]
+    # 5/1005 errors against a 0.1% budget ≈ 5× burn in both windows.
+    assert av["burn"]["60s"] == pytest.approx(4.975, abs=0.01)
+    assert av["burn"]["300s"] == pytest.approx(4.975, abs=0.01)
+    assert av["burning"]
+    lp = s["objectives"]["latency_p99"]
+    # 50/1000 over-threshold against the 1% p99 budget = 5× burn.
+    assert lp["burn"]["60s"] == pytest.approx(5.0, abs=0.01)
+    assert lp["burning"]
+    assert s["burning"] and s["max_burn"] >= 4.9
+    # Breach OLDER than the fast window: slow window still burns, fast
+    # does not — no longer "burning" (a recovered incident).
+    METRICS.reset()
+    METRICS.mark("serve_ok", 1000.0, now=now - 200.0)
+    METRICS.mark("serve_err", 5.0, now=now - 200.0)
+    METRICS.mark("serve_ok", 1000.0, now=now)  # healthy recent traffic
+    s2 = monitor.slo_status(now=now)
+    av2 = s2["objectives"]["availability"]
+    assert av2["burn"]["300s"] > 1.0 > av2["burn"]["60s"]
+    assert not av2["burning"] and not s2["burning"]
+    # Disabled objectives disappear from the report.
+    monkeypatch.setenv("LFM_SLO_P99_MS", "0")
+    monkeypatch.setenv("LFM_SLO_AVAIL", "0")
+    s3 = monitor.slo_status(now=now)
+    assert not s3["active"] and s3["objectives"] == {}
+
+
+# ---- service integration -------------------------------------------------
+
+
+def test_serve_metrics_recorded_and_pinned(service):
+    """Traffic through the real service: the latency histogram is
+    labeled per (universe, width-bucket), its count matches stats()'s
+    completed count exactly, its p99 estimate pins the exact stats()
+    p99 within one bucket, and the /metrics document carries the
+    serve families + gauges."""
+    tr = _universe()
+    entry = service.register("u0", tr)
+    months = service.serveable_months("u0")
+    n = 24
+    lats = [service.score("u0", m).latency_ms for m in months[:n]]
+    stats = service.stats()
+    assert stats["completed"] == n
+    snap = service.metrics_snapshot()
+    hists = snap["instruments"]["histograms"]
+    # Every label set is one (universe, width-bucket) — months near the
+    # panel edge occupy a smaller width bucket, so several can appear.
+    assert all(k.startswith("serve_latency_ms{universe=u0,width=")
+               for k in hists)
+    assert sum(h["count"] for h in hists.values()) == n
+    # The estimate's RIGOROUS small-n invariant: the covering bucket is
+    # the one holding the order statistic at the rank, so the estimate
+    # lies within one bucket factor of s[floor(rank)] — for ANY latency
+    # distribution (a loaded box throws multi-bucket outliers, and the
+    # exact percentile interpolates BETWEEN order stats, so an
+    # estimate-vs-exact pin would flake; the tight large-n pin lives in
+    # test_log_histogram_quantiles_pin_percentile_twin).
+    merged = METRICS.merged_histogram("serve_latency_ms")
+    s = sorted(lats)
+    g = 1.0 + merged.rel_resolution
+    for q in (50.0, 99.0):
+        anchor = s[int((n - 1) * q / 100.0)]
+        est = merged.quantile(q)
+        assert anchor / g - 1e-6 <= est <= anchor * g + 1e-6, (
+            f"q={q}: estimate {est} not within one bucket of the "
+            f"rank's order statistic {anchor}")
+    # Drift plumbing: reference stamped at publish, live streaming
+    # (lazily — size() counts pending mass the readers fold down).
+    assert entry.ref_sketch is not None and entry.live_sketch.size() > 0
+    assert snap["drift"]["universes"]["u0"]["psi"] is not None
+    # The exposition document has every family the scrape consumers
+    # read, and its request count equals the span/stats count.
+    doc = service.metrics_text(ts=1.0)
+    prom = metrics.parse_prometheus(doc)
+    assert sum(v for _, v in prom["lfm_serve_latency_ms_count"]) == n
+    for family in ("lfm_serve_latency_ms_bucket", "lfm_circuit_state",
+                   "lfm_zoo_entries", "lfm_zoo_param_bytes_total",
+                   "lfm_zoo_panel_bytes_total", "lfm_slo_burn",
+                   "lfm_score_drift_psi", "lfm_serve_queue_depth",
+                   "lfm_serve_requests_total",
+                   "lfm_serve_ok_rate_per_sec"):
+        assert family in prom, f"{family} missing from /metrics"
+    assert prom["lfm_zoo_entries"] == [({}, 1.0)]
+    assert prom["lfm_zoo_param_bytes_total"][0][1] > 0
+    assert prom["lfm_scrape_ts_seconds"] == [({}, 1.0)]
+
+
+def test_stats_and_healthz_share_one_snapshot(service):
+    """Satellite pin: /stats and /healthz derive from ONE snapshot()
+    call — single locked read per owning structure, the SAME scrape ts
+    in both — instead of re-deriving state per field."""
+    tr = _universe()
+    service.register("u0", tr)
+    snap = service.snapshot()
+    assert snap["stats"]["ts"] == snap["health"]["ts"] == snap["ts"]
+    assert snap["stats"]["universes"] == {"u0": 0}
+    assert snap["stats"]["zoo_size"] == snap["health"]["zoo_size"] == 1
+    assert snap["health"]["ok"]
+    # SLO/drift detail rides on health without flipping readiness.
+    assert "slo" in snap["health"] and "drift" in snap["health"]
+    assert snap["health"]["drift"]["breached"] == []
+    # The public accessors are views of the same consistent snapshot.
+    assert "ts" in service.stats() and "ts" in service.health()
+
+
+def test_drift_gate_vetoes_publish_and_flips_healthz_detail(
+        service, monkeypatch):
+    """The acceptance pin: a forged distribution shift crosses
+    LFM_DRIFT_MAX, /healthz detail flips, and with LFM_DRIFT_GATE=1 the
+    next atomic publish is VETOED (DriftVetoError) leaving the served
+    generation untouched; with the gate off (default) the publish
+    proceeds."""
+    rng = np.random.default_rng(5)
+    service.register("u0", _universe(seed=0))
+    entry = service.zoo.current("u0")
+    assert entry.ref_sketch is not None
+    # Forge served drift: stream a shifted distribution into the live
+    # sketch (mean shifted by ~2 reference sigmas).
+    mu, sd = entry.ref_sketch.mean(), entry.ref_sketch.std()
+    entry.live_sketch.record(rng.normal(mu + 2 * sd, sd, 6000))
+    psi = entry.drift_psi(min_scores=1)
+    assert psi is not None and psi > metrics.drift_max_default()
+    health = service.health()
+    assert health["ok"]  # drift is detail, not readiness
+    assert health["drift"]["breached"] == ["u0"]
+    # Gauge surfaces on the scrape.
+    prom = metrics.parse_prometheus(service.metrics_text())
+    (labels, v), = prom["lfm_score_drift_psi"]
+    assert labels["universe"] == "u0" and v > metrics.drift_max_default()
+    # Gate ON: publish vetoed, generation 0 still serving.
+    monkeypatch.setenv("LFM_DRIFT_GATE", "1")
+    with pytest.raises(DriftVetoError, match="drift"):
+        service.register("u0", _universe(seed=1))
+    assert service.zoo.generation("u0") == 0
+    d = telemetry.COUNTERS.get("serve_drift_vetoes")
+    assert d and d >= 1
+    # Gate OFF (default): the same publish goes through, and the new
+    # generation starts with a FRESH reference + empty live sketch.
+    monkeypatch.delenv("LFM_DRIFT_GATE")
+    e2 = service.register("u0", _universe(seed=1))
+    assert service.zoo.generation("u0") == 1
+    assert e2.live_sketch is not None and e2.live_sketch.n == 0
+    # The retired generation's PSI gauge must NOT linger in the next
+    # scrape (per-entity gauges are cleared and rebuilt per collection
+    # — a stale series would keep alerting on a generation that no
+    # longer serves).
+    prom2 = metrics.parse_prometheus(service.metrics_text())
+    for labels, _ in prom2.get("lfm_score_drift_psi", []):
+        assert labels["generation"] != "0"
+
+
+def test_metrics_kill_switch_on_the_service(service, monkeypatch):
+    """LFM_METRICS=0 end to end: no reference stamped at publish, no
+    instrument recorded under traffic, gauges not collected — the
+    exposition document is just the scrape timestamp."""
+    monkeypatch.setenv("LFM_METRICS", "0")
+    service.register("u0", _universe())
+    for m in service.serveable_months("u0")[:4]:
+        service.score("u0", m)
+    entry = service.zoo.current("u0")
+    assert entry.ref_sketch is None and entry.live_sketch is None
+    snap = METRICS.snapshot()
+    assert snap["histograms"] == {} and snap["rates_per_sec"] == {}
+    assert snap["gauges"] == {}
+    health = service.health()
+    assert health["ok"] and "slo" not in health and "drift" not in health
+
+
+def test_metrics_non_interference_measured(service, monkeypatch):
+    """The house contract, MEASURED with metrics fully ON: a warm fit
+    pays zero jit traces / zero panel H2D / ONE host sync per epoch;
+    serving steady state pays zero traces / zero panel H2D; and a
+    scrape (snapshot + exposition) in the middle of it all adds zero
+    device work — no device fetch ever originates from the metrics
+    path."""
+    monkeypatch.setenv("LFM_METRICS", "1")
+    # Warm-fit half (the reuse/pipeline lane numbers, unchanged).
+    panel = synthetic_panel(n_firms=60, n_months=160, n_features=5, seed=3)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    tr = Trainer(_cfg(epochs=2), splits)
+    tr.fit()  # cold: compiles + panel transfer
+    snap = REUSE_COUNTERS.snapshot()
+    tr.rebind()
+    out = tr.fit()  # warm
+    d = REUSE_COUNTERS.delta(snap)
+    assert d.get("jit_traces", 0) == 0, d
+    assert d.get("panel_transfers", 0) == 0, d
+    assert d.get("host_syncs", 0) == out["epochs_run"], d
+    # Serving half: steady state with recording + drift streaming on.
+    service.register("u0", _universe())
+    months = service.serveable_months("u0")
+    for m in months[:4]:
+        service.score("u0", m)  # settle first-dispatch paths
+    snap = REUSE_COUNTERS.snapshot()
+    for m in months[:12]:
+        service.score("u0", m)
+    # A mid-traffic scrape: snapshot + text exposition + shared
+    # stats/health snapshot.
+    service.metrics_snapshot()
+    service.metrics_text()
+    service.snapshot()
+    d = REUSE_COUNTERS.delta(snap)
+    assert d.get("jit_traces", 0) == 0, d
+    assert d.get("panel_transfers", 0) == 0, d
+    assert d.get("host_syncs", 0) == 0, d
+
+
+# ---- trace_report cross-check --------------------------------------------
+
+
+def test_trace_report_metrics_section_cross_checks_scrape(
+        service, tmp_path):
+    """Satellite pin: the run dir's saved /metrics scrape is parsed by
+    trace_report's metrics section and cross-checked against the
+    span-derived serve numbers — clean on an honest scrape, LOUD
+    (mismatches listed) on a forged one."""
+    telemetry.COUNTERS.reset()  # scrape totals must cover the run window
+    METRICS.reset()
+    service.register("u0", _universe())
+    months = service.serveable_months("u0")
+    run_dir = str(tmp_path / "run")
+    with telemetry.run_scope(run_dir, extra={"entry": "test_metrics"}):
+        for m in months[:16]:
+            service.score("u0", m)
+        scrape = service.metrics_text()
+    with open(os.path.join(run_dir, "metrics.prom"), "w") as fh:
+        fh.write(scrape)
+    tr = _trace_report()
+    rep = tr.build_report(tr.load_run(run_dir))
+    assert rep["serve"]["completed"] == 16
+    mx = rep["metrics"]
+    assert mx["requests"] == 16
+    assert mx["mismatches"] == [], mx["mismatches"]
+    assert mx["p99_ms"] is not None and mx["rel_resolution"] > 0
+    # Forge the scrape: double the histogram counts — the section must
+    # go loud, not shrug.
+    forged = re.sub(
+        r"^(lfm_serve_latency_ms_count\{[^}]*\}) (\d+)",
+        lambda g: f"{g.group(1)} {int(g.group(2)) * 2}",
+        scrape, flags=re.M)
+    assert forged != scrape
+    with open(os.path.join(run_dir, "metrics.prom"), "w") as fh:
+        fh.write(forged)
+    rep2 = tr.build_report(tr.load_run(run_dir))
+    assert any("requests" in m for m in rep2["metrics"]["mismatches"])
