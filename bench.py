@@ -1613,6 +1613,163 @@ def bench_serve_degradation() -> None:
     _emit("serve_degradation", shed_on["goodput_per_sec"], 0.0, **extras)
 
 
+def bench_serve_restart() -> None:
+    """serve_restart — what durable serving state (serve/persist.py,
+    DESIGN.md §20) buys at restart: time-to-first-correct-response
+    COLD (retrain every universe + compile the warmup trace ladder +
+    first score) vs RESTORED (verified snapshot + drift references
+    re-stamped from serialized sketches + warm ladder from serialized
+    lowered executables + first score), same universes, same process
+    machinery (program/panel caches cleared between phases to simulate
+    the process boundary — the persistent artifacts are all that
+    carries over, exactly the deploy-artifact contract).
+
+    One HARD gate before the row records: the restored service's first
+    response must be BIT-EQUAL to the pre-"crash" one and every
+    universe must recover (a restore that serves different numbers is
+    a failure, not a fast path — the row raises instead of recording).
+    Two ADVISORY contracts surface in the row and warn loudly when
+    breached, so a driver diffing rows sees the numbers move:
+    ``restore_compiles`` (0 with the executable artifacts loading —
+    the zero-cold-start claim) and the cold/restored TTFCR ratio
+    (>= 5x). The value is the ratio."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import serve as serve_mod
+    from lfm_quant_tpu.data.windows import clear_panel_cache
+    from lfm_quant_tpu.serve import ScoringService
+    from lfm_quant_tpu.train import reuse
+    from lfm_quant_tpu.utils import telemetry
+    from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+    n_universes = int(os.environ.get("LFM_BENCH_RESTART_UNIVERSES", "2"))
+    train_epochs = int(os.environ.get("LFM_BENCH_RESTART_EPOCHS", "2"))
+    rtt = dispatch_rtt_ms()
+    store_dir = tempfile.mkdtemp(prefix="lfm_zoo_store_")
+    run_dir = tempfile.mkdtemp(prefix="lfm_restart_bench_")
+    try:
+        def simulate_process_death():
+            # The in-process stand-in for a real process boundary: drop
+            # every compiled-program bundle and the resident panel, so
+            # the next phase pays exactly what a cold process pays —
+            # minus whatever the durable artifacts carry over.
+            reuse.clear_program_cache()
+            clear_panel_cache()
+
+        def build_and_register(svc):
+            refs = {}
+            for name, (trainer, _) in serve_mod.build_universes(
+                    n_universes, train_epochs=train_epochs).items():
+                svc.register(name, trainer)
+                m = svc.serveable_months(name)
+                refs[name] = (m[len(m) // 3],)
+            return refs
+
+        # Phase A — publish: train + register with the durable store
+        # attached; every generation commits (snapshot + probe + execs).
+        svc = ScoringService(persist_dir=store_dir)
+        months = build_and_register(svc)
+        refs = {u: svc.score(u, m[0]).scores.copy()
+                for u, m in months.items()}
+        svc.close()
+
+        # Phase B — RESTORED time-to-first-correct-response.
+        simulate_process_death()
+        snap = REUSE_COUNTERS.snapshot()
+        with telemetry.run_scope(run_dir,
+                                 extra={"entry": "bench_serve_restart"}):
+            t0 = time.perf_counter()
+            svc2 = ScoringService(persist_dir=store_dir)
+            restored = svc2.restore()
+            first_u = sorted(months)[0]
+            r_first = svc2.score(first_u, months[first_u][0])
+            t_restored = time.perf_counter() - t0
+        d = REUSE_COUNTERS.delta(snap)
+        restore_compiles = int(d.get("jit_traces", 0))
+        restore_h2d = int(d.get("panel_transfers", 0))
+        correct = bool(np.array_equal(r_first.scores, refs[first_u]))
+        rest_all = {u: svc2.score(u, m[0]).scores for u, m in
+                    months.items()}
+        correct = correct and all(
+            np.array_equal(rest_all[u], refs[u]) for u in refs)
+        execs_loaded = sum(r.get("execs_loaded", 0) for r in restored)
+        execs_recompiled = sum(r.get("execs_recompiled", 0)
+                               for r in restored)
+        svc2.close()
+        if not correct:
+            raise RuntimeError(
+                "restored scores are NOT bit-equal to the published "
+                "generation's — refusing to record a speed row for a "
+                "restore that serves wrong numbers")
+        if len(restored) != n_universes:
+            raise RuntimeError(
+                f"restore recovered {len(restored)}/{n_universes} "
+                "universes — snapshot verification failed")
+
+        # Phase C — COLD time-to-first-correct-response: the full
+        # retrain + warmup ladder a crash without durable state pays.
+        simulate_process_death()
+        t0 = time.perf_counter()
+        # persist_dir="" pins the store OFF for the cold phase: the
+        # ctor must not fall back to an operator's LFM_ZOO_PERSIST and
+        # journal bench universes into a real store (and pay publish
+        # costs on only one side of the ratio).
+        svc3 = ScoringService(persist_dir="")
+        months3 = build_and_register(svc3)
+        first_u3 = sorted(months3)[0]
+        svc3.score(first_u3, months3[first_u3][0])
+        t_cold = time.perf_counter() - t0
+        svc3.close()
+
+        # Offline cross-check: the restore section must be derivable
+        # from the run dir alone (the trace_report satellite).
+        trace_restore = None
+        try:
+            from lfm_quant_tpu.serve.stats import load_trace_report
+
+            tr = load_trace_report(os.path.dirname(os.path.abspath(
+                __file__)))
+            trace_restore = tr.build_report(
+                tr.load_run(run_dir)).get("restore")
+        except Exception as e:  # noqa: BLE001 — cross-check is a covariate
+            print(f"[bench] serve_restart trace_report cross-check "
+                  f"failed: {type(e).__name__}: {e}", file=sys.stderr,
+                  flush=True)
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+        shutil.rmtree(run_dir, ignore_errors=True)
+    ratio = t_cold / max(t_restored, 1e-9)
+    if restore_compiles > 0:
+        print(f"[bench] WARNING: restore path paid {restore_compiles} "
+              "jit trace(s) — the serialized-executable artifact did "
+              "not fully load (contract: 0)", file=sys.stderr, flush=True)
+    if ratio < 5.0:
+        print(f"[bench] WARNING: restored TTFCR only {ratio:.2f}x "
+              "better than cold (contract: >= 5x)", file=sys.stderr,
+              flush=True)
+    extras = {
+        "unit": "x_cold_vs_restored_ttfcr",
+        "ttfcr_cold_s": round(t_cold, 3),
+        "ttfcr_restored_s": round(t_restored, 3),
+        "restore_compiles": restore_compiles,
+        "restore_panel_h2d": restore_h2d,
+        "execs_loaded": execs_loaded,
+        "execs_recompiled": execs_recompiled,
+        "restored_correct": correct,
+        "n_universes": n_universes,
+        "train_epochs": train_epochs,
+        "trace_restore_wall_s": (trace_restore or {}).get(
+            "restore_wall_s"),
+        "trace_integrity": (trace_restore or {}).get("integrity"),
+    }
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("serve_restart", ratio, 0.0, **extras)
+
+
 def bench_epoch_pipeline() -> None:
     """epoch_pipeline — the async training-loop metric: epochs/hour on a
     CHECKPOINT-ENABLED multi-epoch fit with the one-epoch-lookahead
@@ -2077,7 +2234,7 @@ def main() -> int:
                              "--config-sweep", "--bucketed-train",
                              "--mixed-precision", "--scoring-pipeline",
                              "--epoch-pipeline", "--serve",
-                             "--serve-degradation"):
+                             "--serve-degradation", "--serve-restart"):
                     _cpu_metric_fallback(
                         flag,
                         deadline_s - (time.monotonic() - t_start) - 30.0)
@@ -2177,6 +2334,14 @@ def main() -> int:
             _emit_status("bench_error", stage="serve_degradation",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
+        try:
+            bench_serve_restart()
+        except Exception as e:  # noqa: BLE001 — earlier rows must still reach the driver
+            print(f"bench_serve_restart failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            _emit_status("bench_error", stage="serve_restart",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
         return 0
     except Exception as e:  # noqa: BLE001 — NO exit path may skip the record
         _emit_status("bench_error", stage="harness",
@@ -2228,6 +2393,9 @@ if __name__ == "__main__":
     if "--serve-degradation" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_serve_degradation,
                                      "serve_degradation"))
+    if "--serve-restart" in sys.argv[1:]:
+        sys.exit(_single_metric_main(bench_serve_restart,
+                                     "serve_restart"))
     if "--serve" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_serve, "serve"))
     sys.exit(main())

@@ -14,15 +14,23 @@ package serves the same compiled programs to live traffic:
                per-request latency spans + queue/occupancy counters
                through the PR 4 telemetry registry
   service.py — the front-end: register / warmup / score / submit /
-               refresh (warm single-fold retrain + swap) / stats
+               refresh (warm single-fold retrain + swap) / restore /
+               restart_batcher / stats
+  persist.py — durable serving state (DESIGN.md §20): write-ahead-
+               journaled zoo snapshots (Orbax params + checksum, panel,
+               drift sketch, parity probe, serialized executables),
+               crash-consistent atomic manifest commit, verified
+               zero-cold-start restore with quarantine fallback
   stats.py   — pure-python latency percentiles shared with bench and
                mirrored in scripts/trace_report.py
 
 Entry point: ``serve.py`` at the repo root. Knobs: ``LFM_SERVE_ZOO``,
-``LFM_SERVE_MAX_ROWS``, ``LFM_SERVE_MAX_WAIT_MS``.
+``LFM_SERVE_MAX_ROWS``, ``LFM_SERVE_MAX_WAIT_MS``, ``LFM_ZOO_PERSIST``,
+``LFM_ZOO_KEEP_GENERATIONS``.
 """
 
 from lfm_quant_tpu.serve.batcher import MicroBatcher, ScoreResponse
+from lfm_quant_tpu.serve.persist import ZooStore
 from lfm_quant_tpu.serve.service import ScoringService
 from lfm_quant_tpu.serve.zoo import ModelZoo, ServePrograms, ZooEntry
 
@@ -33,4 +41,5 @@ __all__ = [
     "ScoringService",
     "ServePrograms",
     "ZooEntry",
+    "ZooStore",
 ]

@@ -631,6 +631,37 @@ class MicroBatcher:
         out["rows_real"] = real
         return out
 
+    def carry_stats(self, other: "MicroBatcher") -> None:
+        """Adopt a predecessor batcher's rolling stats — the
+        in-process ``restart_batcher`` recovery (DESIGN.md §20) swaps
+        the thread, not the observability: completed counts, latency
+        window, degradation tallies and peaks carry over so ``stats()``
+        stays continuous across the restart. Both stats locks are
+        taken in sequence, never nested (the predecessor is already
+        closed — nothing concurrently mutates it)."""
+        with other._stats_lock:
+            lat = list(other._lat_ms)
+            snap = (other._rows, other._rows_real, other._batches,
+                    other._requests, other._errors, other._rejects,
+                    other._queue_peak, other._shed,
+                    other._deadline_drops, other._retry_count,
+                    other._breaker_opens)
+        with self._stats_lock:
+            self._lat_ms.extend(lat)
+            (rows, real, batches, requests, errors, rejects, peak,
+             shed, drops, retries, opens) = snap
+            self._rows += rows
+            self._rows_real += real
+            self._batches += batches
+            self._requests += requests
+            self._errors += errors
+            self._rejects += rejects
+            self._queue_peak = max(self._queue_peak, peak)
+            self._shed += shed
+            self._deadline_drops += drops
+            self._retry_count += retries
+            self._breaker_opens += opens
+
     def reset_stats(self) -> None:
         """Zero the rolling stats window (latencies, occupancy, peaks,
         degradation tallies) — bench draws the line between warmup and

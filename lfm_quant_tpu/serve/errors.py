@@ -88,6 +88,28 @@ class BatcherDeadError(ServeError):
         self.cause = cause
 
 
+class SnapshotIntegrityError(ServeError):
+    """A durable zoo generation failed restore-time verification
+    (``serve/persist.py``, DESIGN.md §20): params checksum mismatch,
+    parity-probe bit-inequality, panel hash mismatch, or an unreadable
+    artifact. The restore loop catches it, QUARANTINES the snapshot
+    (renamed aside, loud warning) and falls back to the next-older
+    committed generation — or to a fresh retrain — because serving
+    wrong numbers is the one failure mode a restore may never pick.
+    ``artifact_quarantined`` True means the failing rung already
+    quarantined the faulty artifact itself (e.g. a shared panel file)
+    — the catch must then NOT also quarantine the healthy generation
+    directory. ``skip_quarantine`` True means the failure was
+    ENVIRONMENTAL (a transient device fault mid-restore, an active
+    chaos schedule) — the attempt fails but the snapshot, which may be
+    perfectly healthy, is not condemned. HTTP 500: if it ever reaches
+    a client, something upstream skipped the quarantine ladder."""
+
+    http_status = 500
+    artifact_quarantined = False
+    skip_quarantine = False
+
+
 class DriftVetoError(ServeError):
     """The knob-gated publish veto (``LFM_DRIFT_GATE=1``, DESIGN.md
     §19): the universe's served-score distribution has drifted past

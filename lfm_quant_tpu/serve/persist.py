@@ -1,0 +1,1071 @@
+"""Durable serving state: journaled zoo snapshots, crash-consistent
+publish, zero-cold-start restart (DESIGN.md §20).
+
+Everything the always-on service holds — zoo generations, drift
+reference sketches, the warmed bucket-program ladder — is process
+memory: before this module a crash, eviction, or the documented
+``BatcherDeadError`` "unready until restarted" path lost it all and
+paid a full retrain plus the warmup trace ladder before the first
+correct response. :class:`ZooStore` makes restart a recovery tool:
+
+* **Snapshots** — every published generation is written durably: an
+  Orbax param checkpoint (through ``train/checkpoint.py``'s atomic
+  commit machinery) plus a params checksum, the universe's panel as a
+  content-addressed ``.npz``, the run config and split boundaries, the
+  publish-time drift reference sketch
+  (``utils/metrics.py ScoreSketch.to_state``), a stamped parity-probe
+  score vector (one serveable month, bit-exact float32), and — where
+  the jax build supports AOT export (``train/reuse.py aot_serialize``)
+  — the serialized lowered executable of every warmed bucket program.
+* **Write-ahead journal + atomic manifest** — a publish appends a
+  ``begin`` intent to ``journal.jsonl`` (fsync'd), stages every
+  artifact, then commits by atomically replacing ``manifest.json``
+  (temp file + fsync + rename + directory fsync) and appending
+  ``commit``. The manifest is the single commit point: a crash at ANY
+  instant — enforced by the ``zoo_persist``/``manifest_write`` fault
+  sites (utils/faults.py, ``kind=sigkill`` for a real
+  SIGKILL-mid-publish subprocess test) — leaves either the old or the
+  new manifest, never a torn one. Orphaned artifacts from a crashed
+  commit are resolved by :meth:`ZooStore.sweep` at the next startup
+  (journal replay + unreferenced-file scan).
+* **Restore** (:meth:`ZooStore.restore_into`) — re-registers every
+  universe from the manifest, newest committed generation first, and
+  VERIFIES before serving: params checksum, then one stamped month
+  scored through the restored generation must be bit-equal to the
+  publish-time probe. A corrupt or mismatched snapshot is quarantined
+  (renamed ``*.quarantined.*``) with a loud warning and the restore
+  falls back to the next-older committed generation — or to nothing
+  (fresh retrain) — rather than ever serving wrong numbers. Drift
+  references are re-stamped from the serialized sketches (zero new
+  traces), and the warm ladder is rebuilt through the loaded
+  executables (zero compiles) with a loud counted fallback to
+  recompile (softened by the persistent compilation cache,
+  ``LFM_COMPILATION_CACHE``) on deserialize/topology/fingerprint
+  mismatch.
+* **Retention** — ``LFM_ZOO_KEEP_GENERATIONS`` (default 2) newest
+  generations per universe stay in the manifest; superseded ones are
+  pruned under the same journal discipline (dropped from the manifest
+  FIRST, their directories deleted after the commit).
+
+Single-writer contract: one serving process owns a store directory at
+a time (the same contract the zoo itself has). ``LFM_ZOO_PERSIST``
+unset/``0`` is an EXACT no-op — the service holds no store and no
+serving or training path changes by a single instruction.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+import warnings
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from lfm_quant_tpu.serve.buckets import bucket_rows, bucket_width, rows_ladder
+from lfm_quant_tpu.serve.errors import SnapshotIntegrityError
+from lfm_quant_tpu.utils import faults, telemetry
+
+#: Manifest schema version. A manifest from a NEWER schema is rejected
+#: loudly at restore (quarantine + fresh-start fallback) — a
+#: half-understood manifest must never half-restore.
+SCHEMA_VERSION = 1
+
+
+def persist_dir_default() -> Optional[str]:
+    """``LFM_ZOO_PERSIST``: the durable store directory. Unset, empty
+    or ``"0"`` disables persistence entirely (the exact-no-op
+    contract); any other value is the directory path."""
+    v = os.environ.get("LFM_ZOO_PERSIST", "")
+    return None if v in ("", "0") else v
+
+
+def persist_enabled() -> bool:
+    """Whether durable zoo persistence is configured (the manifest
+    knob probe)."""
+    return persist_dir_default() is not None
+
+
+def keep_generations_default() -> int:
+    """``LFM_ZOO_KEEP_GENERATIONS``: committed generations retained
+    per universe (default 2 — the serving one plus one rollback);
+    older snapshots are pruned under the journal discipline."""
+    return max(1, int(os.environ.get("LFM_ZOO_KEEP_GENERATIONS", "2")))
+
+
+# ---- pure helpers --------------------------------------------------------
+
+
+def params_checksum(params: Any) -> str:
+    """sha256 over the parameter pytree's leaves (shape, dtype and raw
+    bytes, in tree-flatten order) — the restore-side integrity gate's
+    first rung. Host-side only; device leaves are fetched once."""
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(params):
+        a = np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def program_fingerprint(program_key: Tuple) -> str:
+    """Identity of a compiled-program family ACROSS processes: the
+    reuse program key (mesh/model/optimizer/gather/precision-qualified
+    already) plus the jax/jaxlib versions, backend and device count.
+    An AOT executable artifact only loads when this matches — anything
+    else is the loud counted recompile fallback."""
+    import jax
+    import jaxlib
+
+    return hashlib.sha256(repr(
+        (program_key, jax.__version__, jaxlib.__version__,
+         jax.default_backend(), jax.device_count())).encode()).hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def score_single_month(entry: Any, month: int, max_rows: int) -> np.ndarray:
+    """Score ONE month through the entry's bucket programs — the
+    row-bucket-1 member of the warmed ladder, so this dispatch is
+    compile-free on a warmed entry. The publish-time parity probe and
+    the restore-time verification both run through here, so the two
+    vectors are produced by the same code path (bit-equality is then a
+    statement about the snapshot, not about two scoring forks)."""
+    t = entry.month_col(int(month))
+    pool = entry.pool(t)
+    if pool.size == 0:
+        raise ValueError(f"probe month {month} has an empty pool")
+    width = bucket_width(pool.size)
+    rows = bucket_rows(1, max_rows)
+    fi = np.zeros((rows, width), np.int32)
+    ti = np.full((rows,), t, np.int32)
+    w = np.zeros((rows, width), np.float32)
+    fi[0, :pool.size] = pool
+    fi[0, pool.size:] = pool[-1]
+    w[0, :pool.size] = 1.0
+    for i in range(1, rows):
+        fi[i], ti[i] = fi[0], ti[0]
+    with entry.lease_panel() as dev:
+        out = np.asarray(entry.programs_for((rows, width))(
+            entry.params, dev, fi, ti, w))
+    return out[0, :pool.size].copy()
+
+
+def _panel_npz_bytes(panel: Any) -> bytes:
+    """Serialize a Panel to deterministic .npz bytes (same arrays →
+    same bytes → same content address)."""
+    buf = io.BytesIO()
+    arrays = {
+        "features": panel.features, "targets": panel.targets,
+        "target_valid": panel.target_valid, "valid": panel.valid,
+        "returns": panel.returns, "dates": panel.dates,
+        "firm_ids": panel.firm_ids,
+        "feature_names": np.asarray(list(panel.feature_names), dtype=str),
+        "horizon": np.asarray(panel.horizon, np.int64),
+    }
+    if panel.ret_valid is not None:
+        arrays["ret_valid"] = panel.ret_valid
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _panel_from_npz(path: str) -> Any:
+    from lfm_quant_tpu.data.panel import Panel
+
+    with np.load(path, allow_pickle=False) as z:
+        return Panel(
+            features=z["features"], targets=z["targets"],
+            target_valid=z["target_valid"], valid=z["valid"],
+            returns=z["returns"], dates=z["dates"],
+            firm_ids=z["firm_ids"],
+            feature_names=[str(s) for s in z["feature_names"]],
+            horizon=int(z["horizon"]),
+            ret_valid=z["ret_valid"] if "ret_valid" in z.files else None,
+        )
+
+
+#: Trainer kinds a snapshot may record (restore constructs the same
+#: class; anything else is rejected loudly at restore).
+_TRAINER_KINDS = ("Trainer", "EnsembleTrainer")
+
+
+def _build_trainer(kind: str, cfg: Any, splits: Any) -> Any:
+    if kind == "Trainer":
+        from lfm_quant_tpu.train.loop import Trainer
+
+        return Trainer(cfg, splits, run_dir=None)
+    if kind == "EnsembleTrainer":
+        from lfm_quant_tpu.train.ensemble import EnsembleTrainer
+
+        return EnsembleTrainer(cfg, splits, run_dir=None)
+    raise ValueError(
+        f"snapshot records unsupported trainer kind {kind!r} "
+        f"(supported: {', '.join(_TRAINER_KINDS)})")
+
+
+
+
+class ZooStore:
+    """One durable store directory for one serving process.
+
+    Layout::
+
+        <root>/manifest.json            # THE commit point
+        <root>/journal.jsonl            # write-ahead intents (begin/commit)
+        <root>/tmp/                     # atomic-write staging (swept)
+        <root>/universes/<u>/panel_<hash12>.npz
+        <root>/universes/<u>/gen_<g>/params/   # Orbax checkpoint
+        <root>/universes/<u>/gen_<g>/probe.npz
+        <root>/execs/<fp16>_<rows>x<width>.bin # content-addressed by
+                                               # (program fingerprint,
+                                               # bucket) — shared
+                                               # across generations
+    """
+
+    def __init__(self, root: str, keep: Optional[int] = None):
+        self.root = os.path.abspath(root)
+        # Clamped like the env default: keep=0 would make the prune
+        # slice `gens[:-0]` silently empty — retention off forever.
+        self.keep = (max(1, int(keep)) if keep is not None
+                     else keep_generations_default())
+        self.tmp_dir = os.path.join(self.root, "tmp")
+        self.journal_path = os.path.join(self.root, "journal.jsonl")
+        self.manifest_path = os.path.join(self.root, "manifest.json")
+        os.makedirs(self.tmp_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.root, "universes"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "execs"), exist_ok=True)
+        # The manifest is read-modify-written by every publish;
+        # register() and refresh() can run on different threads of one
+        # service (the single-WRITER contract is per store directory,
+        # i.e. per process — not per thread). One commit at a time, or
+        # a racing pair could commit a manifest missing the other's
+        # generation record and the next sweep would reclaim that
+        # committed snapshot as unreferenced.
+        self._commit_lock = threading.Lock()
+        # Same-panel publishes (a refresh over unchanged data) skip the
+        # full re-serialize + re-hash: id-keyed memo, weakref-validated
+        # so a recycled id after GC can never alias a different panel.
+        self._panel_memo: Dict[int, Tuple[Any, str]] = {}
+        # STARTUP sweep: attaching the store IS the process start —
+        # without this, a persist-only service (no --restore) would
+        # accumulate crashed-publish debris and journal lines across
+        # every crash-restart cycle, with nothing ever reclaiming them.
+        # quarantine=False: attach must never rename a corrupt manifest
+        # aside (that is restore's/publish's LOUD decision — an attach
+        # that quarantined would let a subsequent publish commit a
+        # fresh manifest that disowns other universes' snapshots).
+        self.sweep(quarantine=False)
+
+    # ---- low-level durability primitives -----------------------------
+
+    def _atomic_write(self, path: str, data: bytes) -> None:
+        """temp file (in <root>/tmp, same filesystem) + fsync + atomic
+        rename + directory fsync: after this returns, ``path`` durably
+        holds ``data``; before the rename, ``path`` durably holds its
+        previous content. There is no instant at which a reader (or a
+        crash) can observe a torn file."""
+        fd, tmp = tempfile.mkstemp(dir=self.tmp_dir,
+                                   prefix=os.path.basename(path) + ".")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(data)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        _fsync_dir(os.path.dirname(path))
+
+    def _journal(self, rec: Dict[str, Any]) -> None:
+        """Append one fsync'd intent line. The journal is an
+        append-only WAL: ``begin`` before any artifact is staged,
+        ``commit`` after the manifest rename — a ``begin`` without its
+        ``commit`` marks a crashed publish whose staged artifacts
+        :meth:`sweep` reclaims."""
+        with open(self.journal_path, "a") as fh:
+            fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def _quarantine(self, path: str, reason: str) -> None:
+        """Move a failed artifact aside (never delete — it is the
+        operator's evidence), loudly."""
+        dst = f"{path}.quarantined.{int(time.time() * 1e3)}"
+        try:
+            os.replace(path, dst)
+        except OSError as e:
+            warnings.warn(
+                f"durable zoo: could not quarantine {path} ({e}) — "
+                f"original verification failure: {reason}",
+                RuntimeWarning, stacklevel=3)
+            return
+        telemetry.COUNTERS.bump("persist_quarantines")
+        telemetry.instant("restore_quarantine", cat="serve",
+                          path=os.path.relpath(dst, self.root),
+                          reason=reason[:200])
+        warnings.warn(
+            f"durable zoo: QUARANTINED {os.path.relpath(path, self.root)} "
+            f"→ {os.path.basename(dst)}: {reason}",
+            RuntimeWarning, stacklevel=3)
+
+    # ---- manifest ----------------------------------------------------
+
+    def load_manifest(self, quarantine: bool = True
+                      ) -> Optional[Dict[str, Any]]:
+        """The committed manifest, or None when absent — or when it is
+        corrupt/truncated or from a FUTURE schema, in which case it is
+        quarantined with a loud warning (fresh-start fallback; never
+        half-parsed). ``quarantine=False`` reports None WITHOUT
+        renaming/warning — the attach-time sweep's read-only mode."""
+        if not os.path.exists(self.manifest_path):
+            return None
+        try:
+            with open(self.manifest_path) as fh:
+                m = json.load(fh)
+            if not isinstance(m, dict):
+                raise ValueError(f"manifest root is {type(m).__name__}, "
+                                 "not an object")
+            schema = int(m.get("schema_version", -1))
+        except (OSError, ValueError, TypeError) as e:
+            if quarantine:
+                self._quarantine(
+                    self.manifest_path,
+                    f"corrupt manifest: {type(e).__name__}: {e}")
+            return None
+        if schema != SCHEMA_VERSION:
+            if quarantine:
+                self._quarantine(
+                    self.manifest_path,
+                    f"manifest schema_version {schema} != supported "
+                    f"{SCHEMA_VERSION} (a newer writer owns this store; "
+                    "refusing to half-parse it)")
+            return None
+        return m
+
+    def _commit_manifest(self, manifest: Dict[str, Any]) -> None:
+        """The commit point: ``manifest_write`` fault checks bracket
+        the atomic rename (even call index before, odd after), so a
+        scheduled crash — including ``kind=sigkill`` — lands on either
+        side of it."""
+        data = json.dumps(manifest, indent=1, sort_keys=True).encode()
+        faults.check("manifest_write", phase="pre_rename")
+        self._atomic_write(self.manifest_path, data)
+        faults.check("manifest_write", phase="post_rename")
+
+    # ---- publish -----------------------------------------------------
+
+    def record_publish(self, entry: Any, max_rows: int,
+                       probe_month: Optional[int] = None) -> Dict[str, Any]:
+        """Durably record ``entry`` (a warmed, about-to-publish
+        ZooEntry) as its universe's newest committed generation.
+        Called by the service BEFORE the in-memory ``zoo.publish`` —
+        crash after this commit restores the NEW generation, crash
+        before it restores the OLD one; there is no third outcome.
+        Returns the generation record written."""
+        universe, gen = entry.universe, entry.generation
+        # ONE commit at a time: the manifest read-modify-write below
+        # must not interleave with another thread's (register and
+        # refresh may run concurrently on one service — a racing pair
+        # could commit a manifest missing the other's record, whose
+        # snapshot the next sweep would then reclaim as unreferenced).
+        with self._commit_lock:
+            return self._record_publish_locked(entry, universe, gen,
+                                               max_rows, probe_month)
+
+    def _record_publish_locked(self, entry: Any, universe: str, gen: int,
+                               max_rows: int,
+                               probe_month: Optional[int]
+                               ) -> Dict[str, Any]:
+        import jax
+
+        # Fail FAST on an unreadable committed manifest — before any
+        # artifact is staged, and WITHOUT quarantining it (renaming it
+        # here would make the refusal one-shot: the next publish would
+        # see no manifest and commit a fresh one that disowns every
+        # other universe's committed snapshots, which the next sweep
+        # would then reclaim — the exact data loss this guard exists
+        # to prevent). Quarantine stays the restore path's loud
+        # decision; every publish keeps refusing until the operator
+        # resolves the corrupt manifest.
+        had_manifest = os.path.exists(self.manifest_path)
+        manifest = self.load_manifest(quarantine=False)
+        if manifest is None and had_manifest:
+            raise RuntimeError(
+                "durable zoo: refusing to publish over an unreadable "
+                f"manifest ({self.manifest_path} is corrupt or from a "
+                "newer schema) — committing a fresh manifest would "
+                "disown other universes' committed snapshots; resolve "
+                "(restore quarantines it loudly, or remove it by hand) "
+                "first")
+        manifest = manifest or {"schema_version": SCHEMA_VERSION,
+                                "universes": {}}
+        udir = os.path.join(self.root, "universes", universe)
+        gdir_rel = os.path.join("universes", universe, f"gen_{gen:05d}")
+        gdir = os.path.join(self.root, gdir_rel)
+        replaced_rel: Optional[str] = None
+        if os.path.exists(gdir):
+            # The canonical name is taken: either a crashed earlier
+            # attempt (uncommitted — sweep debt) or a COMMITTED
+            # snapshot of the same generation number being
+            # re-published (a cold re-register over an existing
+            # store). Never touch it before the commit point — the
+            # manifest may reference it, and destroying it mid-staging
+            # would put a crash inside a window where the committed
+            # manifest names a gutted snapshot. Stage under a unique
+            # name instead; the superseded dir is GC'd after commit.
+            replaced_rel = gdir_rel
+            gdir_rel = f"{gdir_rel}.r{int(time.time() * 1e3)}"
+            gdir = os.path.join(self.root, gdir_rel)
+        with telemetry.span("zoo_persist_commit", cat="serve",
+                            universe=universe, generation=gen) as sp:
+            self._journal({"op": "publish", "universe": universe,
+                           "generation": gen, "dir": gdir_rel,
+                           "state": "begin", "ts": time.time()})
+            # Chaos site: a crash anywhere in the staging below leaves
+            # a dangling `begin` + partial artifacts that sweep()
+            # reclaims; the manifest still names only committed state.
+            faults.check("zoo_persist", universe=universe, generation=gen)
+            os.makedirs(udir, exist_ok=True)
+            os.makedirs(gdir)
+
+            # Panel: content-addressed per universe (a refresh over the
+            # same panel reuses the file; new data writes a new one).
+            # Same-OBJECT publishes skip the full re-serialize + hash
+            # via the weakref-validated memo — a refresh over unchanged
+            # data pays an exists() check, not a panel copy.
+            memo = self._panel_memo.get(id(entry.panel))
+            psha = (memo[1] if memo is not None
+                    and memo[0]() is entry.panel else None)
+            panel_rel = (os.path.join("universes", universe,
+                                      f"panel_{psha[:12]}.npz")
+                         if psha else None)
+            if psha is None or not os.path.exists(
+                    os.path.join(self.root, panel_rel)):
+                pbytes = _panel_npz_bytes(entry.panel)
+                psha = hashlib.sha256(pbytes).hexdigest()
+                # Prune dead weakrefs on insert — a monthly-refresh
+                # service must not grow one stale entry per publish.
+                self._panel_memo = {k: v for k, v in
+                                    self._panel_memo.items()
+                                    if v[0]() is not None}
+                self._panel_memo[id(entry.panel)] = (
+                    weakref.ref(entry.panel), psha)
+                panel_rel = os.path.join("universes", universe,
+                                         f"panel_{psha[:12]}.npz")
+                panel_path = os.path.join(self.root, panel_rel)
+                if not os.path.exists(panel_path):
+                    self._atomic_write(panel_path, pbytes)
+
+            # Params: host-fetched once — the checksum and the Orbax
+            # snapshot must cover the same bytes.
+            host_params = jax.device_get(entry.params)
+            from lfm_quant_tpu.train.checkpoint import CheckpointManager
+
+            mgr = CheckpointManager(os.path.join(gdir, "params"),
+                                    max_to_keep=1)
+            mgr.save(int(gen) + 1, {"params": host_params}, wait=True)
+            mgr.close()
+
+            # Parity probe: one stamped serveable month, float32
+            # bit-exact — restore must reproduce it exactly through the
+            # same scoring path before the generation may serve.
+            months = entry.serveable_months()
+            month = int(probe_month if probe_month is not None
+                        else months[len(months) // 2])
+            probe_scores = score_single_month(entry, month, max_rows)
+            pbuf = io.BytesIO()
+            np.savez(pbuf, month=np.asarray(month, np.int64),
+                     firm_idx=entry.pool(entry.month_col(month)),
+                     scores=probe_scores.astype(np.float32))
+            self._atomic_write(os.path.join(gdir, "probe.npz"),
+                               pbuf.getvalue())
+
+            # Serialized lowered executables, where supported: the
+            # zero-compile restore artifact (counted; absence is the
+            # documented recompile fallback, not an error). Blobs are
+            # param-INDEPENDENT (params arrive as runtime arguments),
+            # so they are content-addressed by (program fingerprint,
+            # bucket) and shared across generations — a same-key
+            # refresh pays an exists() check per bucket, not a
+            # lower+compile+serialize, and the store holds one copy.
+            trainer = entry.trainer
+            fp = program_fingerprint(trainer.program_key)
+            execs = self._export_execs(entry, fp, max_rows)
+
+            splits = trainer.splits
+            import dataclasses
+
+            rec: Dict[str, Any] = {
+                "generation": int(gen),
+                "dir": gdir_rel,
+                "trainer": type(trainer).__name__,
+                "cfg": dataclasses.asdict(entry.cfg),
+                "splits": {
+                    "train_end_idx": int(splits.train_end_idx),
+                    "val_end_idx": int(splits.val_end_idx),
+                    "train_start_idx": int(splits.train_start_idx)},
+                "panel_file": panel_rel,
+                "panel_sha256": psha,
+                "params_sha256": params_checksum(host_params),
+                "probe": {"month": month, "file":
+                          os.path.join(gdir_rel, "probe.npz")},
+                "ref_sketch": (entry.ref_sketch.to_state()
+                               if entry.ref_sketch is not None else None),
+                "buckets": [[int(r), int(w)] for r in rows_ladder(max_rows)
+                            for w in entry.widths()],
+                "max_rows": int(max_rows),
+                "program_fingerprint": fp,
+                "execs": execs,
+                "saved_at": time.time(),
+            }
+
+            import jaxlib
+
+            manifest["schema_version"] = SCHEMA_VERSION
+            manifest["saved_at"] = time.time()
+            manifest["jax"] = {"version": jax.__version__,
+                               "jaxlib": jaxlib.__version__,
+                               "backend": jax.default_backend(),
+                               "device_count": jax.device_count()}
+            uni = manifest["universes"].setdefault(universe, {})
+            # Records this commit supersedes: an earlier snapshot of
+            # the SAME generation number (its dir may be the canonical
+            # name — already tracked as replaced_rel — or a previous
+            # restage's unique name, which nothing else would reclaim
+            # at commit time).
+            superseded = [g for g in uni.get("generations", [])
+                          if g.get("generation") == int(gen)]
+            gens: List[Dict[str, Any]] = [
+                g for g in uni.get("generations", [])
+                if g.get("generation") != int(gen)]
+            gens.append(rec)
+            gens.sort(key=lambda g: g["generation"])
+            pruned = gens[:-self.keep] if len(gens) > self.keep else []
+            uni["generations"] = gens[len(pruned):]
+
+            # COMMIT. Everything before this line is invisible to a
+            # restore; everything after it is cleanup of state the
+            # manifest no longer references.
+            self._commit_manifest(manifest)
+            self._journal({"op": "publish", "universe": universe,
+                           "generation": gen, "state": "commit",
+                           "ts": time.time()})
+            self._gc(universe, manifest, pruned)
+            # Superseded same-number snapshots: committed out of the
+            # manifest just now, safe to reclaim (kept only if some
+            # other record still references them).
+            kept_dirs = {g["dir"] for g in
+                         manifest["universes"][universe]["generations"]}
+            stale = {g["dir"] for g in superseded} | (
+                {replaced_rel} if replaced_rel is not None else set())
+            for rel in stale - kept_dirs:
+                shutil.rmtree(os.path.join(self.root, rel),
+                              ignore_errors=True)
+            telemetry.COUNTERS.bump("persist_commits")
+            sp.set(execs=len(execs), pruned=len(pruned))
+        return rec
+
+    def _export_execs(self, entry: Any, fp: str,
+                      max_rows: int) -> Dict[str, str]:
+        from lfm_quant_tpu.train import reuse
+
+        execs: Dict[str, str] = {}
+        if not reuse.aot_supported():
+            return execs
+        todo = []
+        for width in entry.widths():
+            for rows in rows_ladder(max_rows):
+                rel = os.path.join("execs", f"{fp[:16]}_{rows}x{width}.bin")
+                if os.path.exists(os.path.join(self.root, rel)):
+                    # Content hit: an earlier generation (or universe)
+                    # with the same program fingerprint already
+                    # exported this bucket's executable.
+                    execs[f"{rows}x{width}"] = rel
+                    telemetry.COUNTERS.bump("persist_execs_reused")
+                else:
+                    todo.append((rows, width, rel))
+        if not todo:
+            return execs
+        with entry.lease_panel() as dev:
+            for rows, width, rel in todo:
+                fi = np.zeros((rows, width), np.int32)
+                ti = np.zeros((rows,), np.int32)
+                w = np.zeros((rows, width), np.float32)
+                sp = entry.programs_for((rows, width))
+                blob = sp.aot_export(entry.params, dev, fi, ti, w)
+                if blob is None:
+                    continue  # aot_serialize warned + counted
+                self._atomic_write(os.path.join(self.root, rel), blob)
+                execs[f"{rows}x{width}"] = rel
+                telemetry.COUNTERS.bump("persist_execs_exported")
+        return execs
+
+    # ---- retention / GC / sweep --------------------------------------
+
+    def _gc(self, universe: str, manifest: Dict[str, Any],
+            pruned: List[Dict[str, Any]]) -> None:
+        """Delete the just-pruned generations' artifacts (the manifest
+        already committed without them) plus any panel file the kept
+        generations no longer reference. Failures warn — GC debt is
+        reclaimed by the next sweep, never worth failing a publish."""
+        kept = manifest["universes"].get(universe, {}).get("generations", [])
+        kept_panels = {g["panel_file"] for g in kept}
+        for g in pruned:
+            d = os.path.join(self.root, g["dir"])
+            try:
+                if os.path.isdir(d):
+                    shutil.rmtree(d)
+                telemetry.COUNTERS.bump("persist_gc_pruned")
+            except OSError as e:
+                warnings.warn(f"durable zoo GC: could not prune {d}: {e}",
+                              RuntimeWarning, stacklevel=2)
+            pf = g.get("panel_file")
+            if pf and pf not in kept_panels:
+                try:
+                    os.unlink(os.path.join(self.root, pf))
+                except OSError:
+                    pass
+
+    def sweep(self, quarantine: bool = True) -> Dict[str, int]:
+        """Startup recovery: replay the journal (a ``begin`` without
+        its ``commit`` is a crashed publish whose staged artifacts must
+        go), drop everything in ``tmp/``, and remove any artifact the
+        committed manifest does not reference. Idempotent;
+        single-writer (runs at store attach — ``quarantine=False``,
+        read-only toward a corrupt manifest — and again at restore,
+        where a corrupt manifest IS quarantined loudly)."""
+        return self._sweep_impl(quarantine)[0]
+
+    def _sweep_impl(self, quarantine: bool
+                    ) -> Tuple[Dict[str, int], Optional[Dict[str, Any]]]:
+        """Sweep + the manifest it loaded (one parse serves both the
+        sweep and the restore that follows it)."""
+        replays = 0
+        begun: Dict[Tuple[str, int], str] = {}
+        for line in self._read_journal():
+            if line.get("op") != "publish":
+                continue
+            key = (line.get("universe"), line.get("generation"))
+            if line.get("state") == "begin":
+                begun[key] = line.get("dir", "")
+            elif line.get("state") == "commit":
+                begun.pop(key, None)
+        manifest = self.load_manifest(quarantine=quarantine)
+        if manifest is None:
+            # No VALID committed reference set: the store is fresh, or
+            # the manifest is unreadable (corrupt / future schema —
+            # quarantined just now when this is the restore-path
+            # sweep). Either way the snapshots on disk cannot be told
+            # apart from committed state, and deleting the operator's
+            # evidence on the strength of a manifest we could not read
+            # would turn a recoverable incident into data loss. Clean
+            # only tmp/; the journal is kept as evidence too.
+            orphans = 0
+            for item in os.listdir(self.tmp_dir):
+                try:
+                    p = os.path.join(self.tmp_dir, item)
+                    os.unlink(p) if os.path.isfile(p) else shutil.rmtree(p)
+                    orphans += 1
+                except OSError:
+                    pass
+            return {"journal_replays": 0, "orphans": orphans}, None
+        referenced: set = set()
+        for uni in manifest.get("universes", {}).values():
+            for g in uni.get("generations", []):
+                referenced.add(g["dir"])
+                referenced.add(g["panel_file"])
+                referenced.update((g.get("execs") or {}).values())
+        orphans = 0
+        for key, rel in begun.items():
+            # Dangling begin: the crashed publish. Its dir is only
+            # removed when the manifest does not reference it (the
+            # crash may have landed AFTER the commit point but before
+            # the journal's commit line — then the manifest owns it).
+            replays += 1
+            if rel and rel not in referenced:
+                d = os.path.join(self.root, rel)
+                if os.path.isdir(d):
+                    shutil.rmtree(d, ignore_errors=True)
+                    orphans += 1
+        # Unreferenced-artifact scan (covers pre-journal debris and GC
+        # failures). Quarantined artifacts are operator evidence — kept.
+        ubase = os.path.join(self.root, "universes")
+        for uname in sorted(os.listdir(ubase)) if os.path.isdir(ubase) \
+                else []:
+            udir = os.path.join(ubase, uname)
+            if not os.path.isdir(udir):
+                continue
+            for item in sorted(os.listdir(udir)):
+                rel = os.path.join("universes", uname, item)
+                if ".quarantined." in item or rel in referenced:
+                    continue
+                path = os.path.join(udir, item)
+                if item.startswith("gen_") and os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                    orphans += 1
+                elif item.startswith("panel_") and item.endswith(".npz"):
+                    try:
+                        os.unlink(path)
+                        orphans += 1
+                    except OSError:
+                        pass
+        # Content-addressed executable blobs no kept generation
+        # references (all their referents pruned/superseded).
+        edir = os.path.join(self.root, "execs")
+        for item in (sorted(os.listdir(edir))
+                     if os.path.isdir(edir) else []):
+            rel = os.path.join("execs", item)
+            if ".quarantined." in item or rel in referenced:
+                continue
+            try:
+                os.unlink(os.path.join(edir, item))
+                orphans += 1
+            except OSError:
+                pass
+        for item in os.listdir(self.tmp_dir):
+            try:
+                p = os.path.join(self.tmp_dir, item)
+                os.unlink(p) if os.path.isfile(p) else shutil.rmtree(p)
+                orphans += 1
+            except OSError:
+                pass
+        # The journal's information is now fully folded into the
+        # manifest + filesystem — truncate it (atomically) so it cannot
+        # grow without bound across restarts.
+        if os.path.exists(self.journal_path):
+            self._atomic_write(self.journal_path, b"")
+        if replays or orphans:
+            telemetry.COUNTERS.bump("persist_journal_replays", replays)
+            telemetry.COUNTERS.bump("persist_sweep_orphans", orphans)
+            telemetry.instant("persist_sweep", cat="serve",
+                              journal_replays=replays, orphans=orphans)
+        return {"journal_replays": replays, "orphans": orphans}, manifest
+
+    def _read_journal(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        if not os.path.exists(self.journal_path):
+            return out
+        with open(self.journal_path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # the torn final line of a crashed append
+        return out
+
+    # ---- restore -----------------------------------------------------
+
+    def restore_into(self, service: Any, warm: bool = True
+                     ) -> List[Dict[str, Any]]:
+        """Re-register every committed universe into ``service``'s zoo,
+        newest generation first with older-generation fallback, each
+        verified (checksum + bit-exact parity probe) before it may
+        serve. Returns one info dict per restored universe; a universe
+        whose every committed generation fails verification restores
+        NOTHING (loud warning — the fresh-retrain fallback) rather
+        than serving wrong numbers."""
+        t0 = time.perf_counter()
+        out: List[Dict[str, Any]] = []
+        with telemetry.span("zoo_restore", cat="serve") as sp:
+            # One parse serves both: the sweep's reference scan and the
+            # restore loop below read the same loaded manifest (a
+            # corrupt one was quarantined by the sweep — loudly).
+            swept, manifest = self._sweep_impl(quarantine=True)
+            if not manifest:
+                sp.set(universes=0, **swept)
+                return out
+            for universe in sorted(manifest.get("universes", {})):
+                gens = manifest["universes"][universe].get("generations", [])
+                restored = None
+                for rec in sorted(gens, key=lambda g: -g["generation"]):
+                    try:
+                        restored = self._restore_generation(
+                            service, universe, rec, warm=warm)
+                        break
+                    except Exception as e:  # noqa: BLE001 — ladder rung
+                        # Quarantine is reserved for an EXPLICIT
+                        # corruption verdict: a SnapshotIntegrityError
+                        # raised by a verification rung, minus the two
+                        # flags (artifact already quarantined itself —
+                        # a shared panel file; or an environmental
+                        # failure — a transient device fault must not
+                        # condemn a possibly-healthy snapshot). Any
+                        # UNDIAGNOSED exception fails this attempt
+                        # loudly and falls back — it is not evidence
+                        # against the snapshot, and the restore as a
+                        # whole must never crash over one generation.
+                        verdict = isinstance(e, SnapshotIntegrityError)
+                        if verdict and not (
+                                e.artifact_quarantined
+                                or e.skip_quarantine):
+                            self._quarantine(
+                                os.path.join(self.root, rec["dir"]),
+                                str(e))
+                        elif not verdict:
+                            warnings.warn(
+                                f"durable zoo: {universe}/gen"
+                                f"{rec.get('generation')}: restore "
+                                f"attempt failed ({type(e).__name__}: "
+                                f"{e}) — snapshot NOT quarantined "
+                                "(undiagnosed failure, not corruption "
+                                "evidence); falling back",
+                                RuntimeWarning, stacklevel=2)
+                        telemetry.COUNTERS.bump(
+                            "restore_integrity_failures")
+                        continue
+                if restored is None:
+                    warnings.warn(
+                        f"durable zoo: universe {universe!r} restored "
+                        "NOTHING (every committed generation failed "
+                        "verification) — degrading to fresh retrain "
+                        "rather than serving wrong numbers",
+                        RuntimeWarning, stacklevel=2)
+                    continue
+                out.append(restored)
+            wall = time.perf_counter() - t0
+            sp.set(universes=len(out), wall_s=round(wall, 3),
+                   execs_loaded=sum(r["execs_loaded"] for r in out),
+                   execs_recompiled=sum(r["execs_recompiled"]
+                                        for r in out),
+                   **swept)
+        return out
+
+    def _restore_generation(self, service: Any, universe: str,
+                            rec: Dict[str, Any], warm: bool
+                            ) -> Dict[str, Any]:
+        """One generation's verify-then-serve ladder. Any rung failing
+        raises :class:`SnapshotIntegrityError` (caller quarantines and
+        falls back)."""
+        import jax
+        import jax.numpy as jnp
+
+        from lfm_quant_tpu.config import RunConfig
+        from lfm_quant_tpu.data.panel import PanelSplits
+        from lfm_quant_tpu.serve.zoo import ZooEntry
+        from lfm_quant_tpu.train.checkpoint import CheckpointManager
+        from lfm_quant_tpu.train.loop import restore_state_dict
+        from lfm_quant_tpu.utils import metrics
+
+        t0 = time.perf_counter()
+        gen = int(rec["generation"])
+        gdir = os.path.join(self.root, rec["dir"])
+
+        # 1. Panel: content hash must match the manifest. A corrupt or
+        # missing panel is an ARTIFACT failure, not a generation
+        # failure: the corrupt file itself is quarantined and the
+        # healthy gen dir is left in place (several generations may
+        # share one content-addressed panel — renaming their dirs over
+        # a panel fault would cascade one flipped bit into the loss of
+        # the universe's whole restore chain).
+        panel_path = os.path.join(self.root, rec["panel_file"])
+        try:
+            with open(panel_path, "rb") as fh:
+                pbytes = fh.read()
+        except OSError as e:
+            err = SnapshotIntegrityError(
+                f"{universe}/gen{gen}: panel file missing ({e})")
+            err.artifact_quarantined = True  # nothing else to rename
+            raise err
+        if hashlib.sha256(pbytes).hexdigest() != rec["panel_sha256"]:
+            reason = (f"{universe}/gen{gen}: panel content hash mismatch "
+                      f"({rec['panel_file']})")
+            self._quarantine(panel_path, reason)
+            err = SnapshotIntegrityError(reason)
+            err.artifact_quarantined = True
+            raise err
+        panel = _panel_from_npz(panel_path)
+
+        # 2. Trainer rebuilt from the recorded config + boundaries —
+        # same program key ⇒ same compiled-program family.
+        try:
+            cfg = RunConfig.from_json(json.dumps(rec["cfg"]))
+            splits = PanelSplits(
+                panel=panel,
+                train_end_idx=rec["splits"]["train_end_idx"],
+                val_end_idx=rec["splits"]["val_end_idx"],
+                train_start_idx=rec["splits"]["train_start_idx"])
+            trainer = _build_trainer(rec.get("trainer", "Trainer"),
+                                     cfg, splits)
+        except (KeyError, TypeError, ValueError) as e:
+            raise SnapshotIntegrityError(
+                f"{universe}/gen{gen}: recorded config/splits do not "
+                f"rebuild a trainer ({type(e).__name__}: {e})")
+
+        # 3. Params: Orbax restore + checksum gate.
+        st = trainer.init_state()
+        mgr = CheckpointManager(os.path.join(gdir, "params"), max_to_keep=1)
+        try:
+            restored = restore_state_dict(
+                mgr, {"params": jax.tree.map(np.asarray, st.params)})
+        except Exception as e:  # noqa: BLE001 — integrity rung
+            raise SnapshotIntegrityError(
+                f"{universe}/gen{gen}: params checkpoint unreadable "
+                f"({type(e).__name__}: {e})")
+        finally:
+            mgr.close()
+        params = restored["params"]
+        if params_checksum(params) != rec["params_sha256"]:
+            raise SnapshotIntegrityError(
+                f"{universe}/gen{gen}: params checksum mismatch — the "
+                "snapshot does not hold the bytes the manifest stamped")
+        commit = getattr(trainer, "_commit_state", lambda s: s)
+        trainer.state = commit(st._replace(
+            params=jax.tree.map(jnp.asarray, params)))
+
+        entry = ZooEntry(universe, gen, trainer)
+
+        # 4. Serialized executables deserialized (no dispatch yet —
+        # and no counter bumps until the probe gate passes, so a
+        # quarantined generation never inflates the restore
+        # accounting), with the loud counted fallback.
+        loaded = fallback = 0
+        fp_now = program_fingerprint(trainer.program_key)
+        fp_match = fp_now == rec.get("program_fingerprint")
+        execs = rec.get("execs") or {}
+        if execs and not fp_match:
+            warnings.warn(
+                f"durable zoo: {universe}/gen{gen}: program fingerprint "
+                "mismatch (jax version / backend / topology / program "
+                "key changed since publish) — serialized executables "
+                "skipped, warm ladder recompiles (persistent "
+                "compilation cache softens this when configured)",
+                RuntimeWarning, stacklevel=2)
+        if fp_match:
+            for bkey, rel in sorted(execs.items()):
+                rows_s, _, width_s = bkey.partition("x")
+                bucket = (int(rows_s), int(width_s))
+                try:
+                    with open(os.path.join(self.root, rel), "rb") as fh:
+                        blob = fh.read()
+                except OSError:
+                    blob = None
+                if blob is not None and \
+                        entry.programs_for(bucket).load_aot(blob):
+                    loaded += 1
+                else:
+                    fallback += 1
+                    warnings.warn(
+                        f"durable zoo: {universe}/gen{gen}: serialized "
+                        f"executable for bucket {bucket} failed to "
+                        "deserialize — that bucket recompiles",
+                        RuntimeWarning, stacklevel=2)
+
+        # 5. The parity-probe gate, BEFORE the full warm ladder: a
+        # snapshot that cannot reproduce its publish-time numbers must
+        # not cost the whole ladder's warmup (the probe itself touches
+        # only the (1, width) bucket — one load or one compile).
+        try:
+            with np.load(os.path.join(gdir, "probe.npz"),
+                         allow_pickle=False) as z:
+                p_month = int(z["month"])
+                p_pool = z["firm_idx"]
+                p_scores = z["scores"]
+        except (OSError, KeyError, ValueError) as e:
+            raise SnapshotIntegrityError(
+                f"{universe}/gen{gen}: probe artifact unreadable "
+                f"({type(e).__name__}: {e})")
+        try:
+            live_pool = entry.pool(entry.month_col(p_month))
+            live = score_single_month(entry, p_month, service.max_rows)
+        except KeyError:
+            # The stamped month is no longer serveable on the rebuilt
+            # entry: snapshot and code genuinely disagree — quarantine.
+            raise SnapshotIntegrityError(
+                f"{universe}/gen{gen}: probe month {p_month} is not "
+                "serveable on the rebuilt entry — snapshot and code "
+                "disagree about the universe's geometry")
+        except Exception as e:  # noqa: BLE001 — environmental, not corrupt
+            # The probe could not RUN (a transient device fault, an
+            # active chaos schedule, OOM): that is an environmental
+            # failure, not evidence against the snapshot — fail this
+            # attempt WITHOUT condemning a possibly-healthy snapshot.
+            err = SnapshotIntegrityError(
+                f"{universe}/gen{gen}: parity probe could not run "
+                f"({type(e).__name__}: {e}) — snapshot NOT quarantined "
+                "(environmental failure, retry the restore)")
+            err.skip_quarantine = True
+            raise err from e
+        if not np.array_equal(live_pool, p_pool) or \
+                not np.array_equal(live.astype(np.float32), p_scores):
+            raise SnapshotIntegrityError(
+                f"{universe}/gen{gen}: parity probe mismatch — month "
+                f"{p_month} scored through the restored generation is "
+                "NOT bit-equal to the publish-time probe")
+        telemetry.COUNTERS.bump("restore_probe_ok")
+
+        # 6+. The snapshot VERIFIED: any failure past this line is
+        # environmental (warmup fault, device hiccup) — the attempt
+        # fails but the bit-verified snapshot is never condemned.
+        try:
+            # The verified generation pays its warm ladder (and only
+            # now do the exec counters record). Honest accounting:
+            # every warmed bucket WITHOUT a loaded executable compiles
+            # here — a missing artifact (export failed at publish), a
+            # failed load (already counted), a fingerprint mismatch,
+            # or a restore-side ladder wider than the published one
+            # all end in the same jit trace.
+            if warm:
+                n_buckets = service.warmup_entry(entry)
+                extra = max(0, n_buckets - loaded) - fallback
+                if extra > 0:
+                    fallback += extra
+            if loaded:
+                telemetry.COUNTERS.bump("restore_execs_loaded", loaded)
+            if fallback:
+                telemetry.COUNTERS.bump("restore_execs_recompiled",
+                                        fallback)
+
+            # 7. Drift reference re-stamped from the serialized sketch
+            # — zero re-scoring, zero new traces. NON-FATAL: the
+            # params/scores verified bit-equal; a malformed sketch
+            # state costs the drift gauge, not the generation.
+            if rec.get("ref_sketch") and metrics.enabled():
+                try:
+                    entry.stamp_reference(
+                        metrics.ScoreSketch.from_state(
+                            rec["ref_sketch"]))
+                except (KeyError, TypeError, ValueError) as e:
+                    warnings.warn(
+                        f"durable zoo: {universe}/gen{gen}: drift "
+                        f"reference sketch unreadable ({e}) — serving "
+                        "WITHOUT a drift reference for this generation",
+                        RuntimeWarning, stacklevel=2)
+
+            service.zoo.publish(entry)
+        except Exception as e:  # noqa: BLE001 — environmental, not corrupt
+            err = SnapshotIntegrityError(
+                f"{universe}/gen{gen}: post-verification restore step "
+                f"failed ({type(e).__name__}: {e}) — snapshot NOT "
+                "quarantined (it verified bit-equal; the failure is "
+                "environmental)")
+            err.skip_quarantine = True
+            raise err from e
+        wall = time.perf_counter() - t0
+        info = {"universe": universe, "generation": gen,
+                "execs_loaded": loaded, "execs_recompiled": fallback,
+                "probe": "bit_equal", "wall_s": round(wall, 3)}
+        telemetry.instant("restore_generation", cat="serve", **info)
+        return info

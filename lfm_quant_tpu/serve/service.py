@@ -48,7 +48,9 @@ class ScoringService:
                  deadline_ms: Optional[float] = None,
                  retries: Optional[int] = None,
                  breaker_threshold: Optional[int] = None,
-                 breaker_cooldown_ms: Optional[float] = None):
+                 breaker_cooldown_ms: Optional[float] = None,
+                 persist_dir: Optional[str] = None,
+                 keep_generations: Optional[int] = None):
         self.zoo = ModelZoo(zoo_capacity or buckets.zoo_capacity_default())
         self.max_rows = max_rows or buckets.max_rows_default()
         self.batcher = MicroBatcher(
@@ -60,6 +62,17 @@ class ScoringService:
             breaker_cooldown_ms=breaker_cooldown_ms)
         self.monitor = ServiceMonitor(self)
         self._refresh_lock = threading.Lock()
+        # Durable serving state (serve/persist.py, DESIGN.md §20):
+        # explicit ctor dir wins, else the LFM_ZOO_PERSIST knob; unset
+        # means NO store object exists and every publish/serve path is
+        # byte-for-byte the pre-persistence one (the exact-no-op
+        # contract, pinned in the durable lane).
+        from lfm_quant_tpu.serve import persist
+
+        pd = persist_dir if persist_dir is not None \
+            else persist.persist_dir_default()
+        self.store = (persist.ZooStore(pd, keep=keep_generations)
+                      if pd else None)
 
     # ---- registration / warmup --------------------------------------
 
@@ -89,6 +102,12 @@ class ScoringService:
         if warm:
             self.warmup_entry(entry)
             self._stamp_reference(entry)
+        # Durable record BEFORE the in-memory swap (DESIGN.md §20): a
+        # crash after the manifest commit restores THIS generation, a
+        # crash before it restores the predecessor — the zoo is pure
+        # derived state either way, never the only copy.
+        if self.store is not None:
+            self.store.record_publish(entry, max_rows=self.max_rows)
         self.zoo.publish(entry)
         return entry
 
@@ -266,8 +285,61 @@ class ScoringService:
                 entry.adopt_programs(cur)
                 self.warmup_entry(entry)
                 self._stamp_reference(entry)
+                if self.store is not None:
+                    self.store.record_publish(entry,
+                                              max_rows=self.max_rows)
                 self.zoo.publish(entry)
             return entry
+
+    # ---- durable restore / in-process recovery -----------------------
+
+    def restore(self, warm: bool = True) -> List[Dict[str, Any]]:
+        """Zero-cold-start restart (serve/persist.py, DESIGN.md §20):
+        re-register every committed universe from the durable store —
+        params verified by checksum, one stamped month verified
+        BIT-EQUAL to the publish-time parity probe, drift references
+        re-stamped from the serialized sketches, and the warm ladder
+        rebuilt through the serialized lowered executables (zero
+        compiles when they load; loud counted recompile fallback).
+        Returns one info dict per restored universe; a snapshot that
+        fails verification is quarantined and the universe degrades to
+        fresh retrain rather than serving wrong numbers."""
+        if self.store is None:
+            raise RuntimeError(
+                "restore() needs a durable store — pass persist_dir= or "
+                "set LFM_ZOO_PERSIST to the store directory")
+        return self.store.restore_into(self, warm=warm)
+
+    def restart_batcher(self) -> Dict[str, Any]:
+        """In-process recovery for the ``BatcherDeadError`` path
+        (DESIGN.md §20): replace the dead batcher thread with a fresh
+        one, SAME knobs, zoo and generations untouched, rolling stats
+        carried over. Bounded: the old batcher's close() joins its
+        thread for at most 10 s. Pending submits were already failed
+        loudly — exactly once — by the death guard (``_die``) or are
+        failed by close() here when the operator restarts a LIVE
+        batcher; nothing is failed twice (done futures are skipped) and
+        nothing hangs. The only remedy before this was a full process
+        restart (serve/batcher.py)."""
+        old = self.batcher
+        was_dead = old._dead is not None
+        old.close()
+        nb = MicroBatcher(
+            self.zoo, self.max_rows, old.max_wait_s * 1e3,
+            queue_max=old.queue_max,
+            deadline_ms=old.default_deadline_s * 1e3,
+            retries=old.retries,
+            breaker_threshold=old._breaker_threshold,
+            breaker_cooldown_ms=old._breaker_cooldown_s * 1e3)
+        nb.carry_stats(old)
+        self.batcher = nb
+        telemetry.COUNTERS.set("serve_batcher_dead", 0)
+        telemetry.COUNTERS.bump("serve_batcher_restarts")
+        telemetry.instant("batcher_restarted", cat="serve",
+                          was_dead=was_dead)
+        return {"ok": True, "was_dead": was_dead,
+                "restarts": telemetry.COUNTERS.get(
+                    "serve_batcher_restarts")}
 
     # ---- observability / lifecycle -----------------------------------
 

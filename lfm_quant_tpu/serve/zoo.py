@@ -59,6 +59,12 @@ class ServePrograms:
 
         self.inner = inner
         self.bucket = bucket
+        # A deserialized AOT executable (train/reuse.py aot_load — the
+        # durable store's deploy artifact, DESIGN.md §20). When set, the
+        # scoring path dispatches it DIRECTLY: zero traces, zero XLA
+        # compiles, the restored process's warm ladder. None on the
+        # normal (publish-side) path.
+        self._aot = None
 
         def score(params, dev, fi, ti, w):
             pred, _, _ = inner._forward_impl(params, dev, fi, ti, w,
@@ -69,7 +75,48 @@ class ServePrograms:
         self._jit_score = ledger_jit(f"serve_score_r{rows}x{width}", score)
 
     def __call__(self, params, dev, fi, ti, w):
+        if self._aot is not None:
+            try:
+                return self._aot(params, dev, fi, ti, w)
+            except Exception as e:  # noqa: BLE001 — loud counted fallback
+                # A loaded executable that rejects live arguments
+                # (sharding/layout drift the load-time probe missed)
+                # falls back to the jit path ONCE, loudly — serving
+                # wrong shapes is impossible (Compiled validates), but
+                # serving nothing is not an option.
+                import warnings
+
+                self._aot = None
+                telemetry.COUNTERS.bump("serve_aot_call_fallbacks")
+                warnings.warn(
+                    f"AOT executable for bucket {self.bucket} rejected a "
+                    f"dispatch ({type(e).__name__}: {e}) — falling back "
+                    "to the jit path (one recompile)",
+                    RuntimeWarning, stacklevel=2)
         return self._jit_score(params, dev, fi, ti, w)
+
+    # ---- serialized-executable artifact (DESIGN.md §20) ----------------
+
+    def aot_export(self, params, dev, fi, ti, w) -> Optional[bytes]:
+        """Serialize this bucket's compiled executable for the given
+        argument avals (train/reuse.py ``aot_serialize``) — the durable
+        store calls this at publish so a restore can skip the compile.
+        None when the jax build/backend cannot export."""
+        from lfm_quant_tpu.train import reuse
+
+        return reuse.aot_serialize(self._jit_score, (params, dev, fi, ti, w))
+
+    def load_aot(self, data: bytes) -> bool:
+        """Adopt a serialized executable (restore path). Returns True
+        when it deserialized; False → caller counts the fallback and
+        the next dispatch traces/compiles normally."""
+        from lfm_quant_tpu.train import reuse
+
+        loaded = reuse.aot_load(data)
+        if loaded is None:
+            return False
+        self._aot = loaded
+        return True
 
 
 class ZooEntry:

@@ -526,16 +526,10 @@ class _LedgeredJit:
 
             from lfm_quant_tpu.utils.profiling import suspend_trace_counting
 
-            def to_aval(x):
-                if not (hasattr(x, "shape") and hasattr(x, "dtype")):
-                    return x
-                sharding = getattr(x, "sharding", None)
-                if not isinstance(sharding, jax.sharding.NamedSharding):
-                    sharding = None
-                return jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                            sharding=sharding)
-
-            avals = jax.tree.map(to_aval, args)
+            # ONE aval rule (module-level _to_aval) shared with AOT
+            # export — the two re-lower paths must never disagree on
+            # what reaches lower() as an aval.
+            avals = jax.tree.map(_to_aval, args)
             with suspend_trace_counting():
                 lowered = self._jitted.lower(*avals, **kwargs)
                 try:
@@ -585,6 +579,97 @@ def ledger_jit(name: str, fn: Callable, **jit_kwargs) -> _LedgeredJit:
     from lfm_quant_tpu.utils.profiling import count_traces
 
     return _LedgeredJit(name, jax.jit(count_traces(name, fn), **jit_kwargs))
+
+
+# ---- serialized lowered executables (AOT export, DESIGN.md §20) ---------
+# The cross-PROCESS twin of the executable caches above, one level below
+# the persistent compilation cache: where the jax version supports it
+# (jax.experimental.serialize_executable on this pin), a compiled
+# program can be serialized at publish time and loaded by a cold process
+# WITHOUT tracing or compiling anything — the durable serving store
+# (serve/persist.py) ships these as deploy artifacts so a restore's
+# warm ladder pays zero compiles. Every step degrades loudly-but-safely:
+# unsupported jax / unserializable backend / topology mismatch returns
+# None and the caller falls back to a counted recompile.
+
+
+def aot_supported() -> bool:
+    """Whether this jax build can serialize/deserialize compiled
+    executables (the AOT export API + picklable pytree defs)."""
+    try:
+        from jax.experimental import serialize_executable  # noqa: F401
+
+        return True
+    except Exception:  # noqa: BLE001 — availability guard
+        return False
+
+
+def _to_aval(x):
+    """Concrete array → ShapeDtypeStruct (NamedSharding kept, other
+    shardings dropped) — the ledger's aval rule, shared by AOT export."""
+    import jax
+
+    if not (hasattr(x, "shape") and hasattr(x, "dtype")):
+        return x
+    sharding = getattr(x, "sharding", None)
+    if not isinstance(sharding, jax.sharding.NamedSharding):
+        sharding = None
+    return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+
+def aot_serialize(jitted: Any, args: Tuple) -> Optional[bytes]:
+    """Serialize the executable ``jitted`` compiles for ``args``'
+    avals: one self-contained blob (executable + arg/result pytrees) a
+    cold process can :func:`aot_load` without tracing or compiling.
+    ``jitted`` may be a raw ``jax.jit`` wrapper or a :class:`_LedgeredJit`
+    (its ``lower`` passthrough). The lower runs under
+    ``suspend_trace_counting`` — export is publish-time bookkeeping, not
+    a program on the serving path, and the zero-trace contracts must not
+    see it. With the persistent compilation cache enabled the embedded
+    ``compile()`` is a disk hit for a program warmup already built.
+    Returns None (never raises) when this jax/backend cannot export."""
+    import pickle
+
+    from lfm_quant_tpu.utils.profiling import suspend_trace_counting
+
+    if not aot_supported():
+        return None
+    try:
+        import jax
+        from jax.experimental import serialize_executable as se
+
+        avals = jax.tree.map(_to_aval, args)
+        with suspend_trace_counting():
+            compiled = jitted.lower(*avals).compile()
+            blob, in_tree, out_tree = se.serialize(compiled)
+        return pickle.dumps((blob, in_tree, out_tree))
+    except Exception as e:  # noqa: BLE001 — export is optional, never fatal
+        telemetry.COUNTERS.bump("aot_serialize_failures")
+        import warnings
+
+        warnings.warn(
+            f"AOT executable export unavailable ({type(e).__name__}: "
+            f"{e}) — restores will recompile this program",
+            RuntimeWarning, stacklevel=2)
+        return None
+
+
+def aot_load(data: bytes) -> Optional[Any]:
+    """Deserialize an :func:`aot_serialize` blob into a callable
+    ``jax.stages.Compiled``. Returns None (never raises) on any
+    deserialize/backend/topology mismatch — the caller counts the
+    fallback and recompiles."""
+    import pickle
+
+    if not aot_supported():
+        return None
+    try:
+        from jax.experimental import serialize_executable as se
+
+        blob, in_tree, out_tree = pickle.loads(data)
+        return se.deserialize_and_load(blob, in_tree, out_tree)
+    except Exception:  # noqa: BLE001 — mismatch is the documented fallback
+        return None
 
 
 _PERSISTENT_CACHE_DIR: Optional[str] = None

@@ -21,13 +21,20 @@ Fault sites (the map lives in DESIGN.md §18):
 * ``ckpt_write``     — staging an Orbax save (train/checkpoint.py
   ``CheckpointManager.save``), the preemption test's rendezvous;
 * ``device_get``     — the counted blocking device→host fetch
-  (utils/profiling.py ``timed_device_get``).
+  (utils/profiling.py ``timed_device_get``);
+* ``zoo_persist``    — staging a durable zoo snapshot (serve/persist.py
+  ``ZooStore.record_publish``: panel/params/probe/exec artifacts);
+* ``manifest_write`` — committing the durable zoo manifest
+  (serve/persist.py, checked TWICE per commit: even call indices fire
+  immediately BEFORE the atomic rename, odd indices immediately AFTER
+  it — so a scheduled crash lands on either side of the commit point;
+  the SIGKILL-mid-publish crash-consistency test's rendezvous).
 
 Spec grammar (``LFM_FAULTS``)::
 
     site:key=val[,key=val...][;site2:...]
 
-    kind=transient|permanent|sigterm   (default transient)
+    kind=transient|permanent|sigterm|sigkill   (default transient)
     at=I[+J+...]   fire on exactly these 0-based call indices
     p=F            else fire per call with probability F (seeded RNG)
     seed=N         the p-mode RNG seed (default 0)
@@ -45,6 +52,11 @@ Kinds: ``transient`` raises :class:`TransientFault` (the retry layer's
 breaker), ``sigterm`` delivers SIGTERM to the current process at the
 site and RETURNS (the grace handler in train/preempt.py turns it into a
 clean stop at the next epoch boundary) — deterministic preemption.
+``sigkill`` delivers SIGKILL: the process dies INSTANTLY at the site —
+no handler, no cleanup, no atexit — which is exactly the "crash at ANY
+instant" a crash-consistency proof needs (the durable-zoo
+SIGKILL-mid-publish subprocess test schedules it at ``zoo_persist`` /
+``manifest_write``).
 
 Determinism: each site keeps a call counter and (for ``p``) a private
 ``random.Random(seed)``; given the same call order, two runs inject the
@@ -75,10 +87,10 @@ from typing import Any, Dict, Optional
 #: The named injection points (the only valid spec sites — a typo'd
 #: site must fail loudly, not silently never fire).
 SITES = ("serve_dispatch", "panel_h2d", "zoo_lease", "ckpt_write",
-         "device_get")
+         "device_get", "zoo_persist", "manifest_write")
 
 #: The supported failure kinds.
-KINDS = ("transient", "permanent", "sigterm")
+KINDS = ("transient", "permanent", "sigterm", "sigkill")
 
 
 class FaultError(RuntimeError):
@@ -257,5 +269,8 @@ def check(site: str, **ctx) -> None:
     if plan.kind == "sigterm":
         os.kill(os.getpid(), signal.SIGTERM)
         return
+    if plan.kind == "sigkill":
+        os.kill(os.getpid(), signal.SIGKILL)
+        return  # unreachable: SIGKILL is not deliverable-later, it kills
     cls = TransientFault if plan.kind == "transient" else PermanentFault
     raise cls(site, idx)

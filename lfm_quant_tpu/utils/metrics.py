@@ -489,6 +489,40 @@ class ScoreSketch:
                     "hi": float(self.edges[-1]),
                     "bins": int(self.edges.size - 1)}
 
+    # -- durable serialization (serve/persist.py, DESIGN.md §20) -------
+
+    def to_state(self) -> Dict[str, Any]:
+        """JSON-serializable full state (edges + counts + moments) —
+        what the durable zoo store writes at publish so a restore can
+        re-stamp the drift reference WITHOUT re-scoring a single month.
+        Lazy mass is drained first, so the state is exact."""
+        self.drain()
+        with self._lock:
+            return {"edges": [float(e) for e in self.edges],
+                    "counts": [int(c) for c in self._counts],
+                    "n": int(self.n),
+                    "sum": float(self._sum),
+                    "sumsq": float(self._sumsq)}
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "ScoreSketch":
+        """Rebuild a sketch from :meth:`to_state` output. Loud on a
+        malformed state (wrong counts length) — a durable artifact that
+        half-parses must never silently stamp a wrong reference."""
+        sk = cls(state["edges"])
+        import numpy as np
+
+        counts = np.asarray(state["counts"], np.int64)
+        if counts.shape != sk._counts.shape:
+            raise ValueError(
+                f"sketch state counts length {counts.size} does not match "
+                f"{sk._counts.size} for {sk.edges.size} edges")
+        sk._counts = counts
+        sk.n = int(state["n"])
+        sk._sum = float(state["sum"])
+        sk._sumsq = float(state["sumsq"])
+        return sk
+
 
 # ---- registry ------------------------------------------------------------
 

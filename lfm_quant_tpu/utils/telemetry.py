@@ -465,6 +465,9 @@ _KNOB_PROBES = (
     # Live metrics plane (LFM_METRICS, DESIGN.md §19): whether the
     # always-on instruments record at all (the /metrics kill switch).
     ("metrics", "lfm_quant_tpu.utils.metrics", "enabled"),
+    # Durable serving state (LFM_ZOO_PERSIST, DESIGN.md §20): whether
+    # published zoo generations are journaled to a durable store.
+    ("zoo_persist", "lfm_quant_tpu.serve.persist", "persist_enabled"),
 )
 
 

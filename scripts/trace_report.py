@@ -440,6 +440,59 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
             "faults_injected": int(
                 counters.get("faults_injected", 0) or 0),
         }
+    # Durable-restore rollup (serve/persist.py, DESIGN.md §20): restore
+    # wall time and per-universe outcomes from the zoo_restore span +
+    # restore_generation instants, executables loaded vs recompiled and
+    # journal/sweep/quarantine accounting from the run-level counters —
+    # so "did the restart actually skip the compile ladder, and did
+    # every snapshot verify?" is answerable from the run dir alone.
+    restores = [s for s in spans if s.get("name") == "zoo_restore"]
+    commits = [s for s in spans if s.get("name") == "zoo_persist_commit"]
+    if restores or commits or counters.get("persist_commits"):
+        gens = [s.get("args", {}) for s in spans
+                if s.get("name") == "restore_generation"]
+        quarantines = [s.get("args", {}) for s in spans
+                       if s.get("name") == "restore_quarantine"]
+        # The verdict keys on BOTH the quarantine instants and the
+        # failure counter: some rungs (e.g. a missing panel file) fail
+        # with nothing left to rename, so no instant is emitted.
+        n_fails = int(counters.get("restore_integrity_failures", 0) or 0)
+        last = restores[-1].get("args", {}) if restores else {}
+        report["restore"] = {
+            "restores": len(restores),
+            "restore_wall_s": round(sum(s.get("dur_s", 0.0)
+                                        for s in restores), 3),
+            "universes_restored": last.get("universes"),
+            "execs_loaded": int(
+                counters.get("restore_execs_loaded", 0) or 0),
+            "execs_recompiled": int(
+                counters.get("restore_execs_recompiled", 0) or 0),
+            "probes_ok": int(counters.get("restore_probe_ok", 0) or 0),
+            # ANY failed rung of the verification ladder (panel hash,
+            # config rebuild, params checksum, parity probe) — each
+            # such generation was quarantined.
+            "integrity_failures": n_fails,
+            # Every restored generation passed the bit-equality gate by
+            # construction; quarantines/failures are the record of the
+            # snapshots that did NOT.
+            "integrity": ("quarantined" if (quarantines or n_fails)
+                          else ("bit_equal" if gens else None)),
+            "generations": [{"universe": a.get("universe"),
+                             "generation": a.get("generation"),
+                             "execs_loaded": a.get("execs_loaded"),
+                             "probe": a.get("probe")} for a in gens],
+            "quarantines": [{"path": a.get("path"),
+                             "reason": a.get("reason")}
+                            for a in quarantines],
+            "journal_replays": int(
+                counters.get("persist_journal_replays", 0) or 0),
+            "sweep_orphans": int(
+                counters.get("persist_sweep_orphans", 0) or 0),
+            "commits": int(counters.get("persist_commits", 0) or 0),
+            "execs_exported": int(
+                counters.get("persist_execs_exported", 0) or 0),
+            "gc_pruned": int(counters.get("persist_gc_pruned", 0) or 0),
+        }
     # Live-metrics cross-check (the /metrics scrape vs the spans — the
     # pull-side plane and the post-hoc plane must tell the same story):
     # served-request count and degradation totals within 1%, the
@@ -631,6 +684,21 @@ def print_report(rep: Dict[str, Any]) -> None:
                   f"retries {sv.get('retries', 0)}  "
                   f"breaker_opens {sv.get('breaker_opens', 0)}  "
                   f"faults_injected {sv.get('faults_injected', 0)}")
+    rs = rep.get("restore")
+    if rs:
+        if rs.get("restores"):
+            print(f"restore     : {rs.get('universes_restored')} "
+                  f"universe(s) in {rs['restore_wall_s']:.2f}s  "
+                  f"execs loaded {rs['execs_loaded']} / recompiled "
+                  f"{rs['execs_recompiled']}  integrity "
+                  f"{rs.get('integrity')}  journal_replays "
+                  f"{rs['journal_replays']}  swept {rs['sweep_orphans']}")
+            for q in rs.get("quarantines") or []:
+                print(f"  QUARANTINED: {q.get('path')} — {q.get('reason')}")
+        if rs.get("commits"):
+            print(f"persist     : {rs['commits']} commit(s)  "
+                  f"execs exported {rs['execs_exported']}  "
+                  f"gc pruned {rs['gc_pruned']}")
     mx = rep.get("metrics")
     if mx:
         p99 = mx.get("p99_ms")

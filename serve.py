@@ -40,24 +40,40 @@ lfm_quant_tpu/serve/errors.py, pinned by tests/test_chaos.py):
     unknown universe / month                → 404
     /healthz degraded                       → 503 + {"ok": false, reason}
 
+Durable serving state (DESIGN.md §20): with ``--persist DIR`` (or
+``LFM_ZOO_PERSIST=DIR``) every published generation is journaled to a
+crash-consistent store — params snapshot + checksum, panel, drift
+reference sketch, a bit-exact parity probe, and serialized lowered
+executables where jax supports AOT export. ``--restore`` then stands
+the service back up from that store: every universe re-registered and
+VERIFIED (checksum + probe bit-equality; corrupt snapshots are
+quarantined loudly and degrade to fresh retrain), the warm ladder
+rebuilt with zero compiles when the executable artifacts load.
+
 Usage:
     python serve.py --universes 3 --requests 200 --run-dir runs/serve
     python serve.py --train-epochs 2 --http 8777
+    python serve.py --persist runs/zoo_store --train-epochs 1
+    python serve.py --persist runs/zoo_store --restore --requests 100
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
 
 
-def build_universes(n: int, train_epochs: int, echo: bool = False):
+def build_universes(n: int, train_epochs: int, echo: bool = False,
+                    only=None):
     """N toy universes with DISTINCT geometries (cross-section width
     and lookback window), each a fitted/initialized Trainer — the
-    mixed-shape traffic the bucket ladder exists for."""
+    mixed-shape traffic the bucket ladder exists for. ``only`` (a set
+    of names) restricts construction to those universes — the partial-
+    restore path retrains just the ones whose snapshots failed."""
     from lfm_quant_tpu.config import (DataConfig, ModelConfig, OptimConfig,
                                       RunConfig)
     from lfm_quant_tpu.data import synthetic_panel
@@ -66,6 +82,8 @@ def build_universes(n: int, train_epochs: int, echo: bool = False):
 
     out = {}
     for k in range(n):
+        if only is not None and f"u{k}" not in only:
+            continue
         n_firms = 60 + 60 * k           # distinct universe sizes
         window = 6 + 3 * k              # distinct lookbacks
         cfg = RunConfig(
@@ -259,21 +277,55 @@ def main(argv=None) -> int:
                          "endpoint on this port until interrupted")
     ap.add_argument("--echo", action="store_true",
                     help="echo training metrics while fitting universes")
+    ap.add_argument("--persist", default=None, metavar="DIR",
+                    help="durable zoo store directory (DESIGN.md §20); "
+                         "every published generation is journaled there "
+                         "(falls back to LFM_ZOO_PERSIST)")
+    ap.add_argument("--restore", action="store_true",
+                    help="stand the service up from the durable store "
+                         "instead of retraining: verified snapshots, "
+                         "re-stamped drift references, warm ladder from "
+                         "serialized executables (universes that fail "
+                         "verification degrade to fresh retrain)")
     args = ap.parse_args(argv)
+    if args.restore and not args.persist \
+            and os.environ.get("LFM_ZOO_PERSIST", "") in ("", "0"):
+        ap.error("--restore needs --persist DIR (or LFM_ZOO_PERSIST)")
 
     from lfm_quant_tpu.serve import ScoringService
     from lfm_quant_tpu.utils import telemetry
     from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
 
     with telemetry.run_scope(args.run_dir, extra={"entry": "serve"}):
-        service = ScoringService()
-        print(f"[serve] building {args.universes} universe(s)…", flush=True)
-        for name, (trainer, _) in build_universes(
-                args.universes, args.train_epochs, echo=args.echo).items():
-            entry = service.register(name, trainer)
-            print(f"[serve] registered {name}: gen {entry.generation}, "
-                  f"{len(entry.serveable_months())} serveable months, "
-                  f"widths {entry.widths()}", flush=True)
+        service = ScoringService(persist_dir=args.persist)
+        restored = []
+        if args.restore:
+            t0 = time.perf_counter()
+            restored = service.restore()
+            wall = time.perf_counter() - t0
+            for info in restored:
+                print(f"[serve] restored {info['universe']}: gen "
+                      f"{info['generation']}, execs loaded "
+                      f"{info['execs_loaded']} / recompiled "
+                      f"{info['execs_recompiled']}, probe {info['probe']}",
+                      flush=True)
+            print(f"[serve] restore: {len(restored)} universe(s) in "
+                  f"{wall:.2f}s", flush=True)
+        # Cold start, or the degrade-to-fresh-retrain path: build every
+        # requested universe the restore did NOT recover (a quarantined
+        # snapshot must cost a retrain, never a missing universe).
+        missing = ({f"u{k}" for k in range(args.universes)}
+                   - {info["universe"] for info in restored})
+        if missing:
+            print(f"[serve] building {len(missing)} universe(s)…",
+                  flush=True)
+            for name, (trainer, _) in build_universes(
+                    args.universes, args.train_epochs,
+                    echo=args.echo, only=missing).items():
+                entry = service.register(name, trainer)
+                print(f"[serve] registered {name}: gen {entry.generation}, "
+                      f"{len(entry.serveable_months())} serveable months, "
+                      f"widths {entry.widths()}", flush=True)
         snap = REUSE_COUNTERS.snapshot()
         wall, errors, refreshed = drive_load(
             service, args.requests, args.threads, refresh_mid=args.refresh)
@@ -295,8 +347,6 @@ def main(argv=None) -> int:
             # scripts/trace_report.py can cross-check the live metrics
             # plane against the span-derived numbers (its `metrics`
             # section — same 1% contract as the stats() twins).
-            import os
-
             with open(os.path.join(args.run_dir, "metrics.prom"),
                       "w") as fh:
                 fh.write(service.metrics_text())

@@ -1,0 +1,746 @@
+"""Durable-serving-state lane (``-m durable``): crash-consistent zoo
+snapshots, verified zero-cold-start restore (DESIGN.md §20).
+
+Pins, in order of importance:
+
+* **Crash consistency, end to end** — a serving process SIGKILLed
+  mid-publish at a fault-injected ``zoo_persist``/``manifest_write``
+  site (utils/faults.py ``kind=sigkill``, a REAL subprocess — no
+  handler, no cleanup) restores to the OLD or the NEW generation, never
+  a torn one, with the restored generation's scores verified bit-equal
+  to its publish-time parity probe before it may serve.
+* **Zero-cold-start** — with the serialized-executable artifacts
+  loading, the restore path pays ZERO jit traces (counted), and drift
+  references re-stamp from the serialized sketches without re-scoring.
+* **Verification ladder** — a future-schema or truncated manifest is
+  rejected loudly (quarantine + fresh-start fallback, never
+  half-parsed); a params-checksum or probe mismatch quarantines the
+  generation and falls back to the next-older committed one, else to
+  fresh retrain.
+* **Retention/GC** — ``LFM_ZOO_KEEP_GENERATIONS`` prunes superseded
+  snapshots under the journal discipline; orphans from a crashed
+  commit are swept at startup (journal replay).
+* **Non-interference** — ``LFM_ZOO_PERSIST`` unset/0 means no store
+  object and byte-identical serving paths (steady state still pays
+  zero traces / zero panel H2D).
+* **In-process batcher recovery** — ``restart_batcher()`` resurrects a
+  dead batcher with the zoo, generations and rolling stats intact.
+
+Module named early in the alphabet on purpose: it must sort before the
+tier-1 timebox cut (ROADMAP tier-1 notes).
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.data.windows import clear_panel_cache
+from lfm_quant_tpu.serve import ScoringService, ZooStore
+from lfm_quant_tpu.serve import persist
+from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.train.loop import Trainer
+from lfm_quant_tpu.utils import faults, metrics, telemetry
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+pytestmark = pytest.mark.durable
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(n_firms=48, window=6, seed=0, epochs=1, name="durable_t"):
+    return RunConfig(
+        name=name,
+        data=DataConfig(n_firms=n_firms, n_months=140, n_features=4,
+                        window=window, dates_per_batch=4,
+                        firms_per_date=24),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (8,)}),
+        optim=OptimConfig(lr=1e-3, epochs=epochs, warmup_steps=2,
+                          loss="mse"),
+        seed=seed,
+    )
+
+
+def _universe(seed=0, panel_seed=5, fit=False):
+    panel = synthetic_panel(n_firms=48, n_months=140, n_features=4,
+                            seed=panel_seed)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    tr = Trainer(_cfg(seed=seed), splits)
+    if fit:
+        tr.fit()
+    else:
+        tr.state = tr.init_state()
+    return tr
+
+
+def _service(store_dir=None, **kw):
+    kw.setdefault("max_rows", 2)
+    kw.setdefault("max_wait_ms", 0.5)
+    return ScoringService(persist_dir=store_dir, **kw)
+
+
+def _simulate_process_death():
+    reuse.clear_program_cache()
+    clear_panel_cache()
+
+
+@pytest.fixture(autouse=True)
+def _durable_hygiene(monkeypatch):
+    """No persist knob, no faults, fresh caches — in AND out."""
+    monkeypatch.delenv("LFM_ZOO_PERSIST", raising=False)
+    monkeypatch.delenv("LFM_ZOO_KEEP_GENERATIONS", raising=False)
+    monkeypatch.delenv("LFM_FAULTS", raising=False)
+    faults.configure("")
+    _simulate_process_death()
+    yield
+    faults.configure("")
+    _simulate_process_death()
+
+
+# ---- knobs / non-interference -------------------------------------------
+
+
+def test_persist_knob_off_is_exact_noop(monkeypatch, tmp_path):
+    assert persist.persist_dir_default() is None
+    assert not persist.persist_enabled()
+    monkeypatch.setenv("LFM_ZOO_PERSIST", "0")
+    assert persist.persist_dir_default() is None
+    monkeypatch.setenv("LFM_ZOO_PERSIST", str(tmp_path / "store"))
+    assert persist.persist_dir_default() == str(tmp_path / "store")
+    assert persist.persist_enabled()
+    monkeypatch.delenv("LFM_ZOO_PERSIST")
+    monkeypatch.setenv("LFM_ZOO_KEEP_GENERATIONS", "5")
+    assert persist.keep_generations_default() == 5
+    monkeypatch.delenv("LFM_ZOO_KEEP_GENERATIONS")
+    assert persist.keep_generations_default() == 2
+    # Off means NO store object — and the serving steady state keeps
+    # the serve-lane contract: zero traces, zero panel H2D per request.
+    svc = _service()
+    assert svc.store is None
+    try:
+        svc.register("us", _universe())
+        m = svc.serveable_months("us")[5]
+        svc.score("us", m)  # settle
+        snap = REUSE_COUNTERS.snapshot()
+        svc.score("us", m)
+        d = REUSE_COUNTERS.delta(snap)
+        assert d.get("jit_traces", 0) == 0, d
+        assert d.get("panel_transfers", 0) == 0, d
+    finally:
+        svc.close()
+
+
+# ---- the roundtrip -------------------------------------------------------
+
+
+def test_publish_restore_roundtrip_bit_equal(tmp_path):
+    store_dir = str(tmp_path / "store")
+    svc = _service(store_dir)
+    try:
+        svc.register("us", _universe(fit=True))
+        months = svc.serveable_months("us")
+        refs = {m: svc.score("us", m).scores.copy()
+                for m in (months[3], months[len(months) // 2], months[-1])}
+        had_sketch = svc.zoo.current("us").ref_sketch is not None
+    finally:
+        svc.close()
+    assert os.path.exists(os.path.join(store_dir, "manifest.json"))
+
+    _simulate_process_death()
+    svc2 = _service(store_dir)
+    try:
+        snap = REUSE_COUNTERS.snapshot()
+        restored = svc2.restore()
+        d = REUSE_COUNTERS.delta(snap)
+        assert [r["universe"] for r in restored] == ["us"]
+        info = restored[0]
+        assert info["generation"] == 0
+        assert info["probe"] == "bit_equal"
+        # Zero-cold-start: every warmed bucket came from a serialized
+        # executable — the restore path paid ZERO jit traces.
+        assert info["execs_loaded"] > 0
+        assert info["execs_recompiled"] == 0
+        assert d.get("jit_traces", 0) == 0, d
+        # Served numbers are the published generation's, bit for bit.
+        for m, ref in refs.items():
+            np.testing.assert_array_equal(svc2.score("us", m).scores, ref)
+        # Drift reference re-stamped from the serialized sketch — no
+        # re-scoring, no new traces (metrics default-on ⇒ stamped).
+        entry = svc2.zoo.current("us")
+        if had_sketch:
+            assert entry.ref_sketch is not None
+            assert entry.live_sketch is not None
+    finally:
+        svc2.close()
+
+
+def test_score_single_month_matches_served_path(tmp_path):
+    """The probe helper and the live serving path are the same compiled
+    program — bit-equal by construction, which is what makes the
+    parity probe a statement about the snapshot, not about two forks."""
+    svc = _service()
+    try:
+        svc.register("us", _universe(fit=True))
+        m = svc.serveable_months("us")[7]
+        served = svc.score("us", m)
+        entry = svc.zoo.current("us")
+        probe = persist.score_single_month(entry, m, svc.max_rows)
+        np.testing.assert_array_equal(probe, served.scores)
+    finally:
+        svc.close()
+
+
+def test_sketch_state_roundtrip():
+    rng = np.random.default_rng(0)
+    sk = metrics.ScoreSketch.reference(rng.normal(size=2048))
+    sk.record(rng.normal(0.2, 1.1, size=512))
+    state = sk.to_state()
+    json.dumps(state)  # must be JSON-serializable
+    sk2 = metrics.ScoreSketch.from_state(state)
+    np.testing.assert_array_equal(sk.counts(), sk2.counts())
+    assert sk2.n == sk.n
+    live = sk.live_twin()
+    live.record(rng.normal(0.5, 1.0, size=4096))
+    assert sk.psi(live) == pytest.approx(sk2.psi(live))
+    bad = dict(state, counts=state["counts"][:-2])
+    with pytest.raises(ValueError, match="counts length"):
+        metrics.ScoreSketch.from_state(bad)
+
+
+# ---- manifest schema evolution / corruption ------------------------------
+
+
+def _tamper_manifest(store_dir, fn):
+    path = os.path.join(store_dir, "manifest.json")
+    with open(path) as fh:
+        m = json.load(fh)
+    out = fn(m)
+    with open(path, "w") as fh:
+        if isinstance(out, str):
+            fh.write(out)
+        else:
+            json.dump(out, fh)
+
+
+def _publish_one(store_dir, fit=False):
+    svc = _service(store_dir)
+    try:
+        svc.register("us", _universe(fit=fit))
+        m = svc.serveable_months("us")[5]
+        ref = svc.score("us", m).scores.copy()
+    finally:
+        svc.close()
+    _simulate_process_death()
+    return m, ref
+
+
+def test_future_schema_manifest_quarantined(tmp_path):
+    store_dir = str(tmp_path / "store")
+    _publish_one(store_dir)
+    _tamper_manifest(store_dir, lambda m: dict(m, schema_version=99))
+    svc = _service(store_dir)
+    try:
+        with pytest.warns(RuntimeWarning, match="QUARANTINED"):
+            restored = svc.restore()
+        assert restored == []  # loud fresh-start fallback, never half-parsed
+        assert not os.path.exists(os.path.join(store_dir, "manifest.json"))
+        assert any(".quarantined." in f for f in os.listdir(store_dir))
+        # The unreadable manifest's snapshots are EVIDENCE, not orphans:
+        # the sweep must not delete them.
+        assert os.path.isdir(os.path.join(store_dir, "universes", "us",
+                                          "gen_00000"))
+    finally:
+        svc.close()
+
+
+def test_corrupt_manifest_quarantined(tmp_path):
+    store_dir = str(tmp_path / "store")
+    _publish_one(store_dir)
+    _tamper_manifest(store_dir, lambda m: json.dumps(m)[:40])  # truncated
+    svc = _service(store_dir)
+    try:
+        with pytest.warns(RuntimeWarning, match="QUARANTINED"):
+            assert svc.restore() == []
+        assert any(".quarantined." in f for f in os.listdir(store_dir))
+    finally:
+        svc.close()
+
+
+def test_publish_refuses_over_unreadable_manifest(tmp_path):
+    """Publishing over a corrupt committed manifest must fail LOUDLY —
+    and keep failing (no quarantine side effect that would let the
+    NEXT publish fork a fresh manifest silently disowning, and letting
+    the next sweep delete, every other universe's committed
+    snapshots). Quarantine is restore's decision, not publish's."""
+    store_dir = str(tmp_path / "store")
+    _publish_one(store_dir)
+    _tamper_manifest(store_dir, lambda m: "{ this is not json")
+    svc = _service(store_dir)
+    try:
+        with pytest.raises(RuntimeError, match="refusing to publish"):
+            svc.register("us", _universe(seed=9))
+        # NOT one-shot: the manifest is still in place and a second
+        # publish refuses again instead of committing a fresh one.
+        assert os.path.exists(os.path.join(store_dir, "manifest.json"))
+        with pytest.raises(RuntimeError, match="refusing to publish"):
+            svc.register("us", _universe(seed=10))
+    finally:
+        svc.close()
+    # The committed snapshot is untouched evidence, and nothing was
+    # quarantined — publish is read-only toward the corrupt manifest.
+    assert os.path.isdir(os.path.join(store_dir, "universes", "us",
+                                      "gen_00000"))
+    assert os.path.exists(os.path.join(store_dir, "manifest.json"))
+
+
+# ---- integrity: checksum + parity probe ----------------------------------
+
+
+def test_params_checksum_mismatch_quarantines(tmp_path):
+    store_dir = str(tmp_path / "store")
+    _publish_one(store_dir)
+
+    def flip(m):
+        rec = m["universes"]["us"]["generations"][-1]
+        rec["params_sha256"] = "0" * 64
+        return m
+
+    _tamper_manifest(store_dir, flip)
+    svc = _service(store_dir)
+    try:
+        with pytest.warns(RuntimeWarning, match="fresh retrain"):
+            assert svc.restore() == []
+        udir = os.path.join(store_dir, "universes", "us")
+        assert any(".quarantined." in f for f in os.listdir(udir))
+    finally:
+        svc.close()
+
+
+def test_probe_mismatch_quarantines_and_falls_back(tmp_path):
+    """A tampered snapshot whose scores would come out wrong is
+    quarantined; restore falls back to the next-older COMMITTED
+    generation and serves ITS (verified) numbers."""
+    store_dir = str(tmp_path / "store")
+    svc = _service(store_dir)
+    try:
+        svc.register("us", _universe(seed=0, fit=False))   # gen 0
+        svc.register("us", _universe(seed=1, fit=False))   # gen 1
+        m = svc.serveable_months("us")[5]
+        gen1_scores = svc.score("us", m).scores.copy()
+    finally:
+        svc.close()
+    _simulate_process_death()
+    # Corrupt gen 1's probe artifact: verification must now fail.
+    gdir = os.path.join(store_dir, "universes", "us", "gen_00001")
+    probe_path = os.path.join(gdir, "probe.npz")
+    with np.load(probe_path, allow_pickle=False) as z:
+        month, fi, scores = int(z["month"]), z["firm_idx"], z["scores"]
+    np.savez(probe_path, month=np.asarray(month, np.int64), firm_idx=fi,
+             scores=scores + np.float32(1e-3))
+    svc2 = _service(store_dir)
+    try:
+        with pytest.warns(RuntimeWarning, match="QUARANTINED"):
+            restored = svc2.restore()
+        assert [r["generation"] for r in restored] == [0]
+        udir = os.path.join(store_dir, "universes", "us")
+        assert any(f.startswith("gen_00001.quarantined.")
+                   for f in os.listdir(udir))
+        # Gen 0 serves — verified — and its numbers differ from gen 1's
+        # (different seeds), i.e. the fallback did not serve the
+        # corrupt generation's numbers.
+        r = svc2.score("us", m)
+        assert r.generation == 0
+        assert not np.array_equal(r.scores, gen1_scores)
+    finally:
+        svc2.close()
+
+
+def test_corrupt_shared_panel_quarantines_panel_not_generations(tmp_path):
+    """Generations share a content-addressed panel file; one flipped
+    bit in it must quarantine THAT file — not cascade renames over the
+    healthy generation directories (which are the operator's path back
+    once the panel is re-materialized)."""
+    store_dir = str(tmp_path / "store")
+    _publish_one(store_dir)
+    udir = os.path.join(store_dir, "universes", "us")
+    panel_file = next(f for f in os.listdir(udir)
+                      if f.startswith("panel_") and f.endswith(".npz"))
+    path = os.path.join(udir, panel_file)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as fh:
+        fh.write(bytes(blob))
+    svc = _service(store_dir)
+    try:
+        with pytest.warns(RuntimeWarning, match="QUARANTINED"):
+            assert svc.restore() == []  # nothing verifiable to serve
+    finally:
+        svc.close()
+    names = os.listdir(udir)
+    assert any(f.startswith(panel_file + ".quarantined.") for f in names)
+    # The healthy snapshot dir stayed in place, un-renamed.
+    assert "gen_00000" in names
+
+
+def test_environmental_restore_failure_never_quarantines(tmp_path):
+    """A transient infrastructure fault DURING a restore (injected
+    panel-H2D fault) must fail the attempt — loudly — without
+    condemning the snapshot: once the environment heals, the same
+    store restores bit-equal."""
+    store_dir = str(tmp_path / "store")
+    m, ref = _publish_one(store_dir)
+    svc = _service(store_dir)
+    try:
+        faults.configure("panel_h2d:n=1,kind=permanent")
+        with pytest.warns(RuntimeWarning, match="NOT quarantined"):
+            assert svc.restore() == []  # the attempt fails...
+        faults.configure("")
+        udir = os.path.join(store_dir, "universes", "us")
+        assert not any(".quarantined." in f for f in os.listdir(udir))
+        restored = svc.restore()  # ...and the healed retry serves
+        assert [r["generation"] for r in restored] == [0]
+        np.testing.assert_array_equal(svc.score("us", m).scores, ref)
+    finally:
+        faults.configure("")
+        svc.close()
+
+
+# ---- retention / GC / sweep ----------------------------------------------
+
+
+def test_retention_prunes_superseded_generations(tmp_path):
+    store_dir = str(tmp_path / "store")
+    svc = _service(store_dir, keep_generations=2)
+    try:
+        for seed in range(3):  # gens 0, 1, 2
+            svc.register("us", _universe(seed=seed))
+    finally:
+        svc.close()
+    udir = os.path.join(store_dir, "universes", "us")
+    gens = sorted(f for f in os.listdir(udir) if f.startswith("gen_")
+                  and ".quarantined." not in f)
+    assert gens == ["gen_00001", "gen_00002"]  # gen 0 pruned by GC
+    with open(os.path.join(store_dir, "manifest.json")) as fh:
+        m = json.load(fh)
+    assert [g["generation"] for g in
+            m["universes"]["us"]["generations"]] == [1, 2]
+    _simulate_process_death()
+    svc2 = _service(store_dir)
+    try:
+        restored = svc2.restore()
+        assert [r["generation"] for r in restored] == [2]
+    finally:
+        svc2.close()
+
+
+def test_sweep_reclaims_orphans_and_replays_journal(tmp_path):
+    store_dir = str(tmp_path / "store")
+    _publish_one(store_dir)
+    store = ZooStore(store_dir)
+    # Forge a crashed publish: dangling journal begin + staged debris.
+    orphan_rel = os.path.join("universes", "us", "gen_00007")
+    os.makedirs(os.path.join(store_dir, orphan_rel))
+    store._journal({"op": "publish", "universe": "us", "generation": 7,
+                    "dir": orphan_rel, "state": "begin", "ts": 0.0})
+    with open(os.path.join(store_dir, "tmp", "leftover.bin"), "wb") as fh:
+        fh.write(b"x" * 16)
+    out = store.sweep()
+    assert out["journal_replays"] == 1
+    assert out["orphans"] >= 2  # the staged dir + the tmp leftover
+    assert not os.path.exists(os.path.join(store_dir, orphan_rel))
+    assert os.listdir(os.path.join(store_dir, "tmp")) == []
+    # The journal is folded down and truncated; committed state intact.
+    assert os.path.getsize(store.journal_path) == 0
+    assert os.path.isdir(os.path.join(store_dir, "universes", "us",
+                                      "gen_00000"))
+    assert store.sweep() == {"journal_replays": 0, "orphans": 0}
+
+
+# ---- fault sites: crash-consistency in-process ---------------------------
+
+
+@pytest.mark.parametrize("spec", ["zoo_persist:at=0,kind=permanent",
+                                  "manifest_write:at=0,kind=permanent"])
+def test_publish_fault_leaves_old_generation_committed(tmp_path, spec):
+    """A publish that dies anywhere before the manifest rename commits
+    NOTHING: the old manifest — and therefore the old generation — is
+    what a restore recovers, never a torn mix."""
+    store_dir = str(tmp_path / "store")
+    m, ref = _publish_one(store_dir)
+    svc = _service(store_dir)
+    try:
+        svc.restore()
+        faults.configure(spec)
+        with pytest.raises(faults.PermanentFault):
+            svc.register("us", _universe(seed=9))
+        faults.configure("")
+    finally:
+        svc.close()
+    _simulate_process_death()
+    svc2 = _service(store_dir)
+    try:
+        restored = svc2.restore()
+        assert [r["generation"] for r in restored] == [0]
+        np.testing.assert_array_equal(svc2.score("us", m).scores, ref)
+    finally:
+        svc2.close()
+
+
+def test_same_generation_republish_never_guts_committed_snapshot(tmp_path):
+    """A cold re-register over an existing store re-publishes the SAME
+    generation number. Staging must never touch the committed snapshot
+    before the commit point: a crash mid-republish leaves the ORIGINAL
+    generation restorable bit for bit; a clean republish supersedes it
+    and reclaims the old directory."""
+    store_dir = str(tmp_path / "store")
+    m, ref = _publish_one(store_dir)
+    # Crashed republish of gen 0 (different params — seed 9), dying
+    # right before the manifest rename: the original must survive.
+    svc = _service(store_dir)
+    try:
+        faults.configure("manifest_write:at=0,kind=permanent")
+        with pytest.raises(faults.PermanentFault):
+            svc.register("us", _universe(seed=9))
+        faults.configure("")
+    finally:
+        svc.close()
+    _simulate_process_death()
+    svc2 = _service(store_dir)
+    try:
+        restored = svc2.restore()
+        assert [r["generation"] for r in restored] == [0]
+        np.testing.assert_array_equal(svc2.score("us", m).scores, ref)
+    finally:
+        svc2.close()
+    _simulate_process_death()
+    # Clean republish of gen 0: supersedes, old snapshot dir reclaimed.
+    svc3 = _service(store_dir)
+    try:
+        svc3.register("us", _universe(seed=9))
+        new_ref = svc3.score("us", m).scores.copy()
+    finally:
+        svc3.close()
+    assert not np.array_equal(new_ref, ref)  # genuinely new params
+    udir = os.path.join(store_dir, "universes", "us")
+    gens = [f for f in os.listdir(udir) if f.startswith("gen_")
+            and ".quarantined." not in f]
+    assert len(gens) == 1  # exactly one committed gen-0 snapshot
+    _simulate_process_death()
+    svc4 = _service(store_dir)
+    try:
+        restored = svc4.restore()
+        assert [r["generation"] for r in restored] == [0]
+        np.testing.assert_array_equal(svc4.score("us", m).scores, new_ref)
+    finally:
+        svc4.close()
+
+
+def test_publish_fault_after_rename_is_committed(tmp_path):
+    """Past the rename the NEW generation is durable even though the
+    journal's commit line (and the in-memory zoo.publish) never ran —
+    the manifest is the single commit point."""
+    store_dir = str(tmp_path / "store")
+    _publish_one(store_dir)
+    svc = _service(store_dir)
+    try:
+        svc.restore()
+        faults.configure("manifest_write:at=1,kind=permanent")
+        with pytest.raises(faults.PermanentFault):
+            svc.register("us", _universe(seed=9))
+        faults.configure("")
+    finally:
+        svc.close()
+    _simulate_process_death()
+    svc2 = _service(store_dir)
+    try:
+        restored = svc2.restore()
+        assert [r["generation"] for r in restored] == [1]
+        assert restored[0]["probe"] == "bit_equal"
+    finally:
+        svc2.close()
+
+
+# ---- the acceptance pin: SIGKILL mid-publish, real subprocess ------------
+
+
+_CHILD = """\
+import sys
+sys.path.insert(0, sys.argv[3])
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, \\
+    RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.serve import ScoringService
+from lfm_quant_tpu.train.loop import Trainer
+
+mode, store_dir = sys.argv[1], sys.argv[2]
+seed = 0 if mode == "gen0" else 9
+cfg = RunConfig(
+    name="durable_child",
+    data=DataConfig(n_firms=48, n_months=140, n_features=4, window=6,
+                    dates_per_batch=4, firms_per_date=24),
+    model=ModelConfig(kind="mlp", kwargs={"hidden": (8,)}),
+    optim=OptimConfig(lr=1e-3, epochs=1, warmup_steps=2, loss="mse"),
+    seed=seed)
+panel = synthetic_panel(n_firms=48, n_months=140, n_features=4, seed=5)
+splits = PanelSplits.by_date(panel, 197801, 198001)
+tr = Trainer(cfg, splits)
+tr.state = tr.init_state()
+svc = ScoringService(max_rows=2, max_wait_ms=0.5, persist_dir=store_dir)
+if mode == "gen1":
+    assert [r["generation"] for r in svc.restore()] == [0]
+svc.register("us", tr)  # gen1 mode: the SIGKILL lands inside this publish
+svc.close()
+print("PUBLISHED")
+"""
+
+
+@pytest.mark.parametrize("spec,expect_gen", [
+    ("zoo_persist:at=0,kind=sigkill", 0),       # killed before staging
+    ("manifest_write:at=0,kind=sigkill", 0),    # killed before the rename
+    ("manifest_write:at=1,kind=sigkill", 1),    # killed after the rename
+])
+def test_sigkill_mid_publish_subprocess_recovers(tmp_path, spec,
+                                                 expect_gen):
+    """The acceptance pin, as a REAL subprocess killed with SIGKILL —
+    no handler, no cleanup, no atexit — at a fault-injected
+    ``zoo_persist``/``manifest_write`` site mid-publish. A restore
+    recovers to the old or the new generation (never torn), serves
+    scores the restore has verified BIT-EQUAL to that generation's
+    publish-time probe, and sweeps the crashed commit's debris."""
+    script = tmp_path / "child_publish.py"
+    script.write_text(_CHILD)
+    store_dir = str(tmp_path / "store")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("LFM_FAULTS", None)
+    env.pop("LFM_ZOO_PERSIST", None)
+
+    out0 = subprocess.run(
+        [sys.executable, str(script), "gen0", store_dir, REPO],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert out0.returncode == 0, (out0.returncode, out0.stderr[-800:])
+
+    env_kill = dict(env, LFM_FAULTS=spec)
+    out1 = subprocess.run(
+        [sys.executable, str(script), "gen1", store_dir, REPO],
+        env=env_kill, capture_output=True, text=True, timeout=240)
+    assert out1.returncode == -signal.SIGKILL, (
+        out1.returncode, out1.stderr[-800:])
+    assert "PUBLISHED" not in out1.stdout  # it really died mid-publish
+
+    # The restarted "process": restore recovers exactly one committed
+    # generation, verified, and serving works with zero incorrect
+    # responses (the probe gate ran before publish).
+    svc = _service(store_dir)
+    try:
+        restored = svc.restore()
+        assert [r["universe"] for r in restored] == ["us"]
+        assert restored[0]["generation"] == expect_gen
+        assert restored[0]["probe"] == "bit_equal"
+        m = svc.serveable_months("us")[5]
+        r = svc.score("us", m)
+        assert r.generation == expect_gen and r.scores.size > 0
+        # The crashed commit left no torn state behind: every
+        # non-quarantined gen dir is referenced by the manifest.
+        with open(os.path.join(store_dir, "manifest.json")) as fh:
+            manifest = json.load(fh)
+        referenced = {os.path.basename(g["dir"]) for g in
+                      manifest["universes"]["us"]["generations"]}
+        udir = os.path.join(store_dir, "universes", "us")
+        on_disk = {f for f in os.listdir(udir) if f.startswith("gen_")
+                   and ".quarantined." not in f}
+        assert on_disk == referenced
+    finally:
+        svc.close()
+
+
+# ---- in-process batcher recovery (serve/batcher.py satellite) ------------
+
+
+def test_restart_batcher_recovers_dead_service(recwarn):
+    """The ``BatcherDeadError`` "unready until restarted" path now has
+    an in-process remedy: restart_batcher() replaces the thread with
+    the zoo, generations and rolling stats intact; pending submits were
+    failed loudly exactly once (by the death guard), and post-restart
+    requests serve bit-equal."""
+    svc = _service()
+    try:
+        svc.register("us", _universe(fit=True))
+        m = svc.serveable_months("us")[5]
+        ref = svc.score("us", m).scores.copy()
+        boom = RuntimeError("boom in _next_batch")
+        # After the swap the loop dies at its NEXT _next_batch call:
+        # if it was still blocked inside the real one, it serves one
+        # more request first; if it had not re-entered yet, the very
+        # next submit meets a dead batcher. Both orderings are the
+        # death guard working (fails pending loudly, marks unready).
+        svc.batcher._next_batch = lambda: (_ for _ in ()).throw(boom)
+        from lfm_quant_tpu.serve.errors import BatcherDeadError
+
+        try:
+            svc.score("us", m)
+        except BatcherDeadError:
+            pass
+        deadline = time.perf_counter() + 5.0
+        while svc.batcher._dead is None and time.perf_counter() < deadline:
+            time.sleep(0.001)
+        assert svc.batcher._dead is not None
+        assert not svc.health()["ok"]
+        with pytest.raises(BatcherDeadError):
+            svc.score("us", m)  # dead batcher fast-fails submits
+        completed_at_death = svc.batcher.stats()["completed"]
+        gen_before = svc.zoo.generation("us")
+
+        out = svc.restart_batcher()
+        assert out["ok"] and out["was_dead"]
+        h = svc.health()
+        assert h["ok"] and h["circuit"] == "closed"
+        assert svc.zoo.generation("us") == gen_before  # zoo untouched
+        r = svc.score("us", m)
+        np.testing.assert_array_equal(r.scores, ref)
+        stats = svc.batcher.stats()
+        # Rolling stats carried across the restart (continuity), plus
+        # exactly the one post-restart request.
+        assert stats["completed"] == completed_at_death + 1
+        assert telemetry.COUNTERS.get("serve_batcher_dead") == 0
+        assert telemetry.COUNTERS.get("serve_batcher_restarts") >= 1
+    finally:
+        telemetry.COUNTERS.set("serve_batcher_dead", 0)
+        svc.close()
+
+
+# ---- observability -------------------------------------------------------
+
+
+def test_restore_section_in_trace_report(tmp_path):
+    store_dir = str(tmp_path / "store")
+    run_dir = str(tmp_path / "run")
+    _publish_one(store_dir)
+    svc = _service(store_dir)
+    try:
+        with telemetry.run_scope(run_dir, extra={"entry": "test_durable"}):
+            restored = svc.restore()
+    finally:
+        svc.close()
+    from lfm_quant_tpu.serve.stats import load_trace_report
+
+    tr_mod = load_trace_report(REPO)
+    rep = tr_mod.build_report(tr_mod.load_run(run_dir))
+    rs = rep.get("restore")
+    assert rs is not None
+    assert rs["universes_restored"] == 1
+    assert rs["restore_wall_s"] > 0
+    assert rs["integrity"] == "bit_equal"
+    assert rs["execs_loaded"] == restored[0]["execs_loaded"]
+    assert rs["execs_recompiled"] == 0
+    assert rs["probes_ok"] == 1 and rs["integrity_failures"] == 0
+    assert rs["generations"][0]["universe"] == "us"
