@@ -135,6 +135,17 @@ def _precision_name() -> str:
         return "unknown"
 
 
+def _flight_on() -> bool:
+    """Whether the black-box flight recorder (DESIGN.md §21) was live
+    for this row — a provenance tag, never worth crashing for."""
+    try:
+        from lfm_quant_tpu.utils import flight
+
+        return flight.enabled()
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def _emit(metric: str, value: float, mfu_pct: float, **extras) -> None:
     base = _baseline(metric)
     rec = {
@@ -1412,6 +1423,11 @@ def bench_serve() -> None:
         "request_errors": n_request_errors,
         "metrics_overhead_pct": metrics_overhead_pct,
         "metrics_overhead_spread_pct": overhead_spread_pct,
+        # Provenance for the §21 re-pin: the overhead A/B above ran
+        # with the flight recorder + request tracing + exemplars live
+        # (they are always-on by default; the <2% contract now prices
+        # them too — LFM_METRICS gates only the instruments).
+        "flight_on": _flight_on(),
         "metrics_mismatches": (len(metrics_mismatches)
                                if metrics_mismatches is not None
                                else None),

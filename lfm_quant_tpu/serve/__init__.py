@@ -21,20 +21,29 @@ package serves the same compiled programs to live traffic:
                drift sketch, parity probe, serialized executables),
                crash-consistent atomic manifest commit, verified
                zero-cold-start restore with quarantine fallback
+  incident.py — automatic incident capture (DESIGN.md §21): the
+               existing degradation signals (breaker open, SLO burn,
+               drift veto, snapshot quarantine, shed spike) each write
+               one rate-limited self-contained evidence bundle —
+               flight-recorder ring, /metrics scrape, one-lock
+               snapshot, slowest request traces, host identity
   stats.py   — pure-python latency percentiles shared with bench and
                mirrored in scripts/trace_report.py
 
 Entry point: ``serve.py`` at the repo root. Knobs: ``LFM_SERVE_ZOO``,
 ``LFM_SERVE_MAX_ROWS``, ``LFM_SERVE_MAX_WAIT_MS``, ``LFM_ZOO_PERSIST``,
-``LFM_ZOO_KEEP_GENERATIONS``.
+``LFM_ZOO_KEEP_GENERATIONS``, ``LFM_FLIGHT``, ``LFM_INCIDENT_DIR``,
+``LFM_INCIDENT_COOLDOWN_S``, ``LFM_ACCESS_LOG``.
 """
 
 from lfm_quant_tpu.serve.batcher import MicroBatcher, ScoreResponse
+from lfm_quant_tpu.serve.incident import IncidentManager
 from lfm_quant_tpu.serve.persist import ZooStore
 from lfm_quant_tpu.serve.service import ScoringService
 from lfm_quant_tpu.serve.zoo import ModelZoo, ServePrograms, ZooEntry
 
 __all__ = [
+    "IncidentManager",
     "MicroBatcher",
     "ModelZoo",
     "ScoreResponse",

@@ -57,9 +57,11 @@ one measurement, three consumers, no drift); every dispatch is a sync
 
 from __future__ import annotations
 
+import heapq
 import random
 import threading
 import time
+import uuid
 import warnings
 from collections import deque
 from concurrent.futures import Future
@@ -84,7 +86,26 @@ from lfm_quant_tpu.serve.errors import (
     is_transient,
 )
 from lfm_quant_tpu.serve.zoo import ModelZoo
-from lfm_quant_tpu.utils import faults, metrics, telemetry
+from lfm_quant_tpu.utils import faults, flight, metrics, telemetry
+
+
+def new_request_id() -> str:
+    """A fresh 32-hex trace id (W3C ``traceparent`` trace-id width, so
+    an id minted here propagates cleanly into any tracing fabric)."""
+    return uuid.uuid4().hex
+
+
+def clean_request_id(rid: Optional[str]) -> Optional[str]:
+    """Sanitize an INBOUND id (header-sourced — hostile by default):
+    keep it opaque but bounded and log-line-safe. None/empty → None
+    (the caller mints one)."""
+    if not rid:
+        return None
+    # Pre-truncate BEFORE the per-character filter: header values can
+    # be tens of KB, and the filter must not scan all of it per request.
+    rid = "".join(c for c in str(rid).strip()[:256]
+                  if c.isalnum() or c in "-_.")[:64]
+    return rid or None
 
 
 class ScoreResponse(NamedTuple):
@@ -102,14 +123,26 @@ class ScoreResponse(NamedTuple):
     firm_idx: np.ndarray
     scores: np.ndarray
     latency_ms: float
+    #: Request-scoped trace id (DESIGN.md §21): minted at submit or
+    #: propagated from the caller's X-Request-Id / traceparent header —
+    #: the same id the serve_request span, the access log, the slow-
+    #: trace tracker and the histogram exemplars all carry.
+    request_id: str = ""
+    #: The per-request phase breakdown (ms): queue_ms (submit → joined
+    #: a batch), batch_ms (coalescing-window wait), dispatch_ms (the
+    #: successful device attempt), retry_ms (failed attempts+backoff),
+    #: retries (count). Recorded O(1) from perf_counter stamps.
+    phases: Optional[Dict[str, Any]] = None
 
 
 class _Request:
     __slots__ = ("universe", "month", "width", "future", "t_submit",
-                 "span", "deadline")
+                 "span", "deadline", "rid", "t_batched", "t_dispatch0",
+                 "t_dispatch", "retries")
 
     def __init__(self, universe: str, month: int, width: int,
-                 future: Future, span, deadline: Optional[float]):
+                 future: Future, span, deadline: Optional[float],
+                 rid: str):
         self.universe = universe
         self.month = month
         self.width = width
@@ -117,10 +150,39 @@ class _Request:
         self.t_submit = time.perf_counter()
         self.span = span
         self.deadline = deadline  # absolute perf_counter seconds, or None
+        self.rid = rid
+        # Phase stamps (perf_counter): set as the request moves through
+        # the pipeline — queue pop, first dispatch attempt, last
+        # dispatch attempt. O(1) per request, no allocation.
+        self.t_batched: Optional[float] = None
+        self.t_dispatch0: Optional[float] = None
+        self.t_dispatch: Optional[float] = None
+        self.retries = 0
+
+    def phase_breakdown(self, t_done: float) -> Dict[str, Any]:
+        """The queue/batch/dispatch/retry split of this request's
+        latency, in ms (DESIGN.md §21). Stamps missing on early-failed
+        requests degrade to the last known boundary, so the phases
+        always sum to ~latency."""
+        tb = self.t_batched if self.t_batched is not None else t_done
+        td0 = self.t_dispatch0 if self.t_dispatch0 is not None else t_done
+        td = self.t_dispatch if self.t_dispatch is not None else td0
+        return {
+            "queue_ms": round(max(0.0, tb - self.t_submit) * 1e3, 3),
+            "batch_ms": round(max(0.0, td0 - tb) * 1e3, 3),
+            "retry_ms": round(max(0.0, td - td0) * 1e3, 3),
+            "dispatch_ms": round(max(0.0, t_done - td) * 1e3, 3),
+            "retries": self.retries,
+        }
 
 
 class MicroBatcher:
     """The queue + batcher thread. One instance per ScoringService."""
+
+    #: How many slowest request traces the rolling tracker keeps (the
+    #: incident bundles' ``slow_requests.json`` and the trace_report
+    #: waterfall's depth). Bounded heap: O(log K) per completion.
+    SLOW_TRACES_K = 16
 
     def __init__(self, zoo: ModelZoo, max_rows: int, max_wait_ms: float,
                  latency_window: int = 65536,
@@ -158,6 +220,17 @@ class MicroBatcher:
         self._dead: Optional[BaseException] = None
         self._stats_lock = threading.Lock()
         self._lat_ms: "deque[float]" = deque(maxlen=max(1, latency_window))
+        # The K slowest completed request traces since the last stats
+        # reset (a bounded min-heap — O(log K) per completion, keyed on
+        # latency with a monotone tiebreak so trace dicts never
+        # compare): the incident bundles' slow-request evidence and the
+        # trace_report waterfall's cross-check anchor.
+        self._slow: List[Tuple[float, int, Dict[str, Any]]] = []
+        self._slow_seq = 0
+        # Incident hook (serve/incident.py): set by ScoringService; a
+        # breaker OPEN transition triggers an automatic capture. Plain
+        # attribute read on the failure path — never on the hot path.
+        self.incidents: Optional[Any] = None
         self._rows = 0
         self._rows_real = 0
         self._batches = 0
@@ -176,7 +249,8 @@ class MicroBatcher:
     # ---- client side -------------------------------------------------
 
     def submit(self, universe: str, month: int,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None) -> Future:
         """Enqueue one scoring query; the Future resolves to a
         :class:`ScoreResponse` (or raises the routing/validation/
         degradation error). Validation that only needs the ROUTING
@@ -185,7 +259,11 @@ class MicroBatcher:
         circuit, full queue) fails fast the same way. ``deadline_ms``
         (else ``LFM_SERVE_DEADLINE_MS``; 0/None = none) bounds how long
         the request may wait — past it the batcher DROPS it before
-        dispatch."""
+        dispatch. ``request_id`` propagates a caller-supplied trace id
+        (the front door's X-Request-Id / traceparent header); None
+        mints a fresh one — either way the id rides the span, the
+        response, the access log and the exemplars (DESIGN.md §21)."""
+        rid = clean_request_id(request_id) or new_request_id()
         future: Future = Future()
         dead = self._dead
         if dead is not None:
@@ -216,8 +294,10 @@ class MicroBatcher:
                     if deadline_ms and deadline_ms > 0 else None)
         span = telemetry.begin_async("serve_request", cat="serve",
                                      universe=universe, month=int(month),
-                                     n_firms=int(n_firms))
-        req = _Request(universe, int(month), width, future, span, deadline)
+                                     n_firms=int(n_firms),
+                                     request_id=rid)
+        req = _Request(universe, int(month), width, future, span, deadline,
+                       rid)
         shed = False
         with self._cv:
             if self._dead is not None:
@@ -239,6 +319,12 @@ class MicroBatcher:
             span.end(error="shed")
             telemetry.COUNTERS.bump("serve_shed")
             metrics.METRICS.mark("serve_err")  # availability budget
+            # Dedicated shed ring: the incident layer's shed-rate-spike
+            # trigger reads it (serve/monitor.py) — serve_err blends
+            # sheds with dispatch errors and deadline drops.
+            metrics.METRICS.mark("serve_shed")
+            flight.record("shed", universe=universe, month=int(month),
+                          request_id=rid, queue_max=self.queue_max)
             with self._stats_lock:
                 self._shed += 1
             future.set_exception(ShedError(self.queue_max))
@@ -300,6 +386,14 @@ class MicroBatcher:
             telemetry.instant("circuit_open", cat="serve", streak=streak)
             with self._stats_lock:
                 self._breaker_opens += 1
+            # Automatic incident capture (DESIGN.md §21): the breaker
+            # opening IS the degradation moment — snapshot the evidence
+            # (flight ring, scrape, slow traces) before it scrolls
+            # away. The capture runs on its own thread; this is one
+            # attribute read + a rate-limited trigger call.
+            inc = self.incidents
+            if inc is not None:
+                inc.trigger("breaker_open", streak=streak)
 
     # ---- batcher thread ----------------------------------------------
 
@@ -359,14 +453,16 @@ class MicroBatcher:
                     return None
                 self._cv.wait(0.05)
             first = self._queue.popleft()
+            first.t_batched = time.perf_counter()
             key = (first.universe, first.width)
             batch = [first]
-            deadline = time.perf_counter() + self.max_wait_s
+            deadline = first.t_batched + self.max_wait_s
             while len(batch) < self.max_rows:
                 matched = False
                 for i, r in enumerate(self._queue):
                     if (r.universe, r.width) == key:
                         del self._queue[i]
+                        r.t_batched = time.perf_counter()
                         batch.append(r)
                         matched = True
                         break
@@ -396,7 +492,10 @@ class MicroBatcher:
             if r.future.done():
                 continue  # already routed (validation failure)
             if r.deadline is not None and now >= r.deadline:
-                r.span.end(error="deadline")
+                r.span.end(error="deadline", **r.phase_breakdown(now))
+                flight.record("deadline_drop", universe=r.universe,
+                              month=r.month, request_id=r.rid,
+                              overdue_ms=round((now - r.deadline) * 1e3, 3))
                 r.future.set_exception(
                     DeadlineError(r.universe, r.month, now - r.deadline))
                 dropped += 1
@@ -429,9 +528,17 @@ class MicroBatcher:
                 if (not is_transient(e) or attempt >= self.retries
                         or self._stop):
                     self._dispatch_fail()
+                    flight.record("dispatch_fail", universe=universe,
+                                  rows=len(batch), attempt=attempt,
+                                  error=type(e).__name__)
                     raise
                 attempt += 1
+                for r in batch:
+                    r.retries += 1
                 telemetry.COUNTERS.bump("serve_retries")
+                flight.record("retry", universe=universe,
+                              rows=len(batch), attempt=attempt,
+                              error=type(e).__name__)
                 with self._stats_lock:
                     self._retry_count += 1
                 # Capped exponential backoff with full jitter: bounded at
@@ -441,6 +548,16 @@ class MicroBatcher:
                            * (0.5 + random.random()))
 
     def _dispatch_once(self, universe: str, batch: List[_Request]) -> None:
+        # Phase stamps (O(1) per request): first attempt fixes the end
+        # of the coalescing wait, the last attempt anchors dispatch_ms
+        # — the gap between the two is retry_ms (failed attempts plus
+        # backoff). Stamped BEFORE the fault site: an injected failure
+        # is part of the attempt it fails.
+        t_attempt = time.perf_counter()
+        for r in batch:
+            if r.t_dispatch0 is None:
+                r.t_dispatch0 = t_attempt
+            r.t_dispatch = t_attempt
         faults.check("serve_dispatch", universe=universe,
                      rows=len(batch))
         with self.zoo.lease(universe) as entry:
@@ -505,16 +622,30 @@ class MicroBatcher:
             gen = entry.generation
         lats = []
         score_slices = []
+        slow_items = []
         for i, r in enumerate(batch):
             pool = pools[i][1]
             lat = round((t_done - r.t_submit) * 1e3, 3)
             lats.append(lat)
             scores = out[i, :pool.size].copy()
             score_slices.append(scores)
-            r.span.end(latency_ms=lat, generation=gen)
+            # The per-request causal trail (DESIGN.md §21): where the
+            # latency went — queue, coalescing window, retries,
+            # dispatch — echoed in the span (trace_report's waterfall),
+            # the response (the client/access log) and the slow-trace
+            # tracker (incident bundles).
+            phases = r.phase_breakdown(t_done)
+            phases["width"] = width  # the bucket that served it
+            slow_items.append({
+                "request_id": r.rid, "universe": universe,
+                "month": r.month, "rows": rows,
+                "generation": gen, "latency_ms": lat, **phases})
+            r.span.end(latency_ms=lat, generation=gen,
+                       request_id=r.rid, **phases)
             r.future.set_result(ScoreResponse(
                 universe=universe, month=r.month, generation=gen,
-                firm_idx=pool, scores=scores, latency_ms=lat))
+                firm_idx=pool, scores=scores, latency_ms=lat,
+                request_id=r.rid, phases=phases))
         # Live metrics plane (utils/metrics.py, DESIGN.md §19): O(1)
         # per event, lock-guarded inside each instrument, exact no-op
         # under LFM_METRICS=0. Latency attributed per (universe,
@@ -531,8 +662,13 @@ class MicroBatcher:
             # closed-loop contention costs a scheduling quantum.
             hist = m.histogram("serve_latency_ms",
                                universe=universe, width=width)
-            for lat in lats:
-                hist.record(lat)
+            for r, lat in zip(batch, lats):
+                # Exemplar wiring (DESIGN.md §21): each bucket keeps
+                # the last trace id that landed in it — O(1), no
+                # allocation growth — so a p99 bucket in a scrape
+                # points at a REAL request whose phase breakdown is in
+                # the slow-trace tracker / span record.
+                hist.record(lat, exemplar=r.rid)
             m.mark("serve_ok", float(len(batch)))
             slo_ms = metrics.slo_p99_ms_default()
             if slo_ms > 0:
@@ -548,14 +684,33 @@ class MicroBatcher:
         telemetry.COUNTERS.bump("serve_batches")
         telemetry.COUNTERS.bump("serve_rows", rows)
         telemetry.COUNTERS.bump("serve_rows_real", len(batch))
+        flight.record("dispatch", universe=universe, rows=rows,
+                      rows_real=len(batch), width=width, generation=gen,
+                      ms=round((t_done - t_attempt) * 1e3, 3))
         with self._stats_lock:
             self._lat_ms.extend(lats)
             self._rows += rows
             self._rows_real += len(batch)
             self._batches += 1
             self._requests += len(batch)
+            for item in slow_items:
+                self._slow_seq += 1
+                entry = (item["latency_ms"], self._slow_seq, item)
+                if len(self._slow) < self.SLOW_TRACES_K:
+                    heapq.heappush(self._slow, entry)
+                elif entry[0] > self._slow[0][0]:
+                    heapq.heapreplace(self._slow, entry)
 
     # ---- stats / health / lifecycle ----------------------------------
+
+    def slow_traces(self) -> List[Dict[str, Any]]:
+        """The K slowest completed request traces since the last stats
+        reset, slowest first — request id, routing, and the full
+        queue/batch/retry/dispatch phase breakdown each (the incident
+        bundles' slow-request evidence, DESIGN.md §21)."""
+        with self._stats_lock:
+            items = [dict(item) for _, _, item in self._slow]
+        return sorted(items, key=lambda d: -d["latency_ms"])
 
     def queue_depth(self) -> int:
         """Current queue depth (gauge read: a single ``len`` is
@@ -641,6 +796,7 @@ class MicroBatcher:
         closed — nothing concurrently mutates it)."""
         with other._stats_lock:
             lat = list(other._lat_ms)
+            slow = [item for _, _, item in other._slow]
             snap = (other._rows, other._rows_real, other._batches,
                     other._requests, other._errors, other._rejects,
                     other._queue_peak, other._shed,
@@ -648,6 +804,13 @@ class MicroBatcher:
                     other._breaker_opens)
         with self._stats_lock:
             self._lat_ms.extend(lat)
+            for item in slow:
+                self._slow_seq += 1
+                entry = (item["latency_ms"], self._slow_seq, item)
+                if len(self._slow) < self.SLOW_TRACES_K:
+                    heapq.heappush(self._slow, entry)
+                elif entry[0] > self._slow[0][0]:
+                    heapq.heapreplace(self._slow, entry)
             (rows, real, batches, requests, errors, rejects, peak,
              shed, drops, retries, opens) = snap
             self._rows += rows
@@ -670,6 +833,7 @@ class MicroBatcher:
         not reset — it is live machinery, not a statistic."""
         with self._stats_lock:
             self._lat_ms.clear()
+            self._slow.clear()
             self._rows = self._rows_real = 0
             self._batches = self._requests = 0
             self._errors = self._rejects = 0

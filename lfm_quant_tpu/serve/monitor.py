@@ -177,6 +177,14 @@ class ServiceMonitor:
             telemetry.instant("drift_veto", cat="serve",
                               universe=universe, psi=round(psi, 4),
                               threshold=drift_max)
+            # A veto IS the incident (DESIGN.md §21): the serving
+            # distribution left its reference badly enough to block a
+            # publish — capture the evidence while the live sketch
+            # still holds the drifted stream.
+            inc = getattr(self._service, "incidents", None)
+            if inc is not None:
+                inc.trigger("drift_veto", universe=universe,
+                            psi=round(psi, 4), threshold=drift_max)
             raise DriftVetoError(universe, psi, drift_max)
 
     # ---- gauge collection --------------------------------------------
@@ -235,6 +243,47 @@ class ServiceMonitor:
                 METRICS.gauge("score_drift_psi", float(rec["psi"]),
                               universe=universe,
                               generation=rec["generation"])
+        # Fleet identity (ROADMAP item 2 groundwork): WHICH build and
+        # backend produced this scrape — the classic value-1 info gauge
+        # (git sha, jax/jaxlib, backend, resolved dtype, device count,
+        # host), from the cached telemetry.build_info() probe.
+        info = telemetry.build_info()
+        METRICS.clear_gauges("build_info")
+        METRICS.gauge(
+            "build_info", 1.0,
+            git_sha=(info.get("git_sha") or "unknown")[:12],
+            jax=info.get("jax") or "unknown",
+            jaxlib=info.get("jaxlib") or "unknown",
+            backend=info.get("backend") or "unknown",
+            dtype=info.get("dtype") or "unknown",
+            device_count=info.get("device_count") or 0,
+            host=info.get("host") or "unknown")
+        # Incident triggers evaluated at scrape/snapshot time (the
+        # signals are windowed aggregates — there is no per-event
+        # moment to hook): a burning SLO or a shed-rate spike starts a
+        # rate-limited capture (serve/incident.py; its own scrape is
+        # re-entrancy-guarded there).
+        inc = getattr(svc, "incidents", None)
+        if inc is not None:
+            if slo.get("burning"):
+                inc.trigger("slo_burn", max_burn=slo.get("max_burn"),
+                            objectives=sorted(slo.get("objectives", {})))
+            from lfm_quant_tpu.serve.incident import (
+                SHED_SPIKE_FRACTION, SHED_SPIKE_MIN_EVENTS,
+                SHED_SPIKE_WINDOW_S)
+
+            shed = METRICS.window_total("serve_shed",
+                                        SHED_SPIKE_WINDOW_S, now=now)
+            ok = METRICS.window_total("serve_ok", SHED_SPIKE_WINDOW_S,
+                                      now=now)
+            err = METRICS.window_total("serve_err", SHED_SPIKE_WINDOW_S,
+                                       now=now)
+            total = ok + err
+            if (total >= SHED_SPIKE_MIN_EVENTS
+                    and shed / total > SHED_SPIKE_FRACTION):
+                inc.trigger("shed_spike", shed_60s=int(shed),
+                            traffic_60s=int(total),
+                            fraction=round(shed / total, 4))
         return {"slo": slo, "drift": drift}
 
     # ---- exposition --------------------------------------------------
@@ -258,6 +307,11 @@ class ServiceMonitor:
             "slo": status["slo"],
             "drift": status["drift"],
             "instruments": METRICS.snapshot(),
+            # Trace-id exemplars per latency bucket (DESIGN.md §21):
+            # the JSON surface only — the text exposition stays plain
+            # 0.0.4 (OpenMetrics exemplar syntax would break every
+            # parse twin and any strict scraper).
+            "exemplars": METRICS.exemplar_snapshot("serve_latency_ms"),
             "counters": {
                 k: v for k, v in
                 sorted(telemetry.COUNTERS.snapshot().items())

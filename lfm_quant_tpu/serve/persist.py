@@ -258,6 +258,11 @@ class ZooStore:
         # generation record and the next sweep would reclaim that
         # committed snapshot as unreferenced.
         self._commit_lock = threading.Lock()
+        # Incident hook (serve/incident.py, DESIGN.md §21): set by the
+        # owning ScoringService — a quarantine verdict triggers an
+        # automatic evidence bundle. Plain attribute, None when the
+        # store is used standalone (tests, tooling).
+        self.incidents: Optional[Any] = None
         # Same-panel publishes (a refresh over unchanged data) skip the
         # full re-serialize + re-hash: id-keyed memo, weakref-validated
         # so a recycled id after GC can never alias a different panel.
@@ -323,6 +328,14 @@ class ZooStore:
         telemetry.instant("restore_quarantine", cat="serve",
                           path=os.path.relpath(dst, self.root),
                           reason=reason[:200])
+        # A quarantine is an incident trigger (DESIGN.md §21): durable
+        # state failed verification — capture the evidence bundle
+        # (rate-limited; never raises back into the restore ladder).
+        inc = self.incidents
+        if inc is not None:
+            inc.trigger("quarantine",
+                        path=os.path.relpath(dst, self.root),
+                        reason=reason[:200])
         warnings.warn(
             f"durable zoo: QUARANTINED {os.path.relpath(path, self.root)} "
             f"→ {os.path.basename(dst)}: {reason}",

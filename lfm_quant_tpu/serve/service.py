@@ -50,7 +50,9 @@ class ScoringService:
                  breaker_threshold: Optional[int] = None,
                  breaker_cooldown_ms: Optional[float] = None,
                  persist_dir: Optional[str] = None,
-                 keep_generations: Optional[int] = None):
+                 keep_generations: Optional[int] = None,
+                 incident_dir: Optional[str] = None,
+                 incident_cooldown_s: Optional[float] = None):
         self.zoo = ModelZoo(zoo_capacity or buckets.zoo_capacity_default())
         self.max_rows = max_rows or buckets.max_rows_default()
         self.batcher = MicroBatcher(
@@ -61,6 +63,18 @@ class ScoringService:
             breaker_threshold=breaker_threshold,
             breaker_cooldown_ms=breaker_cooldown_ms)
         self.monitor = ServiceMonitor(self)
+        # Automatic incident capture (serve/incident.py, DESIGN.md
+        # §21): the existing degradation signals — breaker open, SLO
+        # burn, drift veto, snapshot quarantine, shed spike — each
+        # write one rate-limited evidence bundle. The batcher and the
+        # durable store get back-references so their trigger sites are
+        # one attribute read.
+        from lfm_quant_tpu.serve.incident import IncidentManager
+
+        self.incidents = IncidentManager(
+            self, incident_dir=incident_dir,
+            cooldown_s=incident_cooldown_s)
+        self.batcher.incidents = self.incidents
         self._refresh_lock = threading.Lock()
         # Durable serving state (serve/persist.py, DESIGN.md §20):
         # explicit ctor dir wins, else the LFM_ZOO_PERSIST knob; unset
@@ -73,6 +87,8 @@ class ScoringService:
             else persist.persist_dir_default()
         self.store = (persist.ZooStore(pd, keep=keep_generations)
                       if pd else None)
+        if self.store is not None:
+            self.store.incidents = self.incidents
 
     # ---- registration / warmup --------------------------------------
 
@@ -212,21 +228,29 @@ class ScoringService:
     # ---- query path --------------------------------------------------
 
     def submit(self, universe: str, month: int,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               request_id: Optional[str] = None) -> Future:
         """Async query: Future of a :class:`ScoreResponse`.
         ``deadline_ms`` bounds how long the request may wait — past it
-        the batcher drops it BEFORE dispatch (DeadlineError)."""
-        return self.batcher.submit(universe, month, deadline_ms=deadline_ms)
+        the batcher drops it BEFORE dispatch (DeadlineError).
+        ``request_id`` propagates an inbound trace id (DESIGN.md §21);
+        None mints one — the response echoes it either way."""
+        return self.batcher.submit(universe, month,
+                                   deadline_ms=deadline_ms,
+                                   request_id=request_id)
 
     def score(self, universe: str, month: int,
-              timeout: Optional[float] = 60.0) -> ScoreResponse:
+              timeout: Optional[float] = 60.0,
+              request_id: Optional[str] = None) -> ScoreResponse:
         """Sync query: the month's scored cross-section. The client
         ``timeout`` PROPAGATES into the batcher as the request deadline,
         so a request this caller has already given up on is dropped
-        instead of costing a device dispatch (DESIGN.md §18)."""
+        instead of costing a device dispatch (DESIGN.md §18).
+        ``request_id`` propagates an inbound trace id (DESIGN.md §21)."""
         return self.batcher.submit(
             universe, month,
             deadline_ms=None if timeout is None else timeout * 1e3,
+            request_id=request_id,
         ).result(timeout=timeout)
 
     def serveable_months(self, universe: str) -> List[int]:
@@ -332,6 +356,7 @@ class ScoringService:
             breaker_threshold=old._breaker_threshold,
             breaker_cooldown_ms=old._breaker_cooldown_s * 1e3)
         nb.carry_stats(old)
+        nb.incidents = self.incidents
         self.batcher = nb
         telemetry.COUNTERS.set("serve_batcher_dead", 0)
         telemetry.COUNTERS.bump("serve_batcher_restarts")
@@ -366,6 +391,10 @@ class ScoringService:
         stats["universes"] = zsnap["universes"]
         stats["zoo_size"] = zsnap["size"]
         stats["zoo_capacity"] = zsnap["capacity"]
+        stats["incidents"] = {
+            "captured": self.incidents.captured,
+            "suppressed": self.incidents.suppressed,
+        }
         health = self.batcher.health()
         health["ts"] = ts
         health["zoo_size"] = zsnap["size"]
@@ -414,3 +443,7 @@ class ScoringService:
 
     def close(self) -> None:
         self.batcher.close()
+        # A capture racing shutdown finishes its bundle (bounded): a
+        # breaker-open incident seconds before close is exactly the
+        # evidence worth keeping.
+        self.incidents.wait(timeout=5.0)

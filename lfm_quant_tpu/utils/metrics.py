@@ -115,7 +115,8 @@ class LogHistogram:
     totals and means carry no bucketing error at all."""
 
     __slots__ = ("lo", "hi", "growth", "_log_lo", "_inv_log_g",
-                 "_counts", "count", "sum", "vmin", "vmax", "_lock")
+                 "_counts", "count", "sum", "vmin", "vmax", "_lock",
+                 "_exemplars")
 
     def __init__(self, lo: float = 1e-2, hi: float = 1e5,
                  buckets_per_decade: int = 20):
@@ -134,6 +135,13 @@ class LogHistogram:
         self.sum = 0.0
         self.vmin = math.inf
         self.vmax = -math.inf
+        # Exemplars (DESIGN.md §21): per bucket, the LAST (trace_id,
+        # value, unix_ts) that landed there — one fixed slot per
+        # bucket (the classic Prometheus client behavior), so a p99
+        # bucket points at a real request id whose full phase
+        # breakdown lives in the span record / slow-trace tracker.
+        # Bounded by construction: at most one tuple per bucket.
+        self._exemplars: Dict[int, Tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
     @property
@@ -149,7 +157,7 @@ class LogHistogram:
         i = int(math.ceil((math.log(v) - self._log_lo) * self._inv_log_g))
         return min(max(i, 1), len(self._counts) - 1)
 
-    def record(self, v: float) -> None:
+    def record(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         i = self._index(v)
         with self._lock:
@@ -160,6 +168,25 @@ class LogHistogram:
                 self.vmin = v
             if v > self.vmax:
                 self.vmax = v
+            if exemplar is not None:
+                self._exemplars[i] = (exemplar, v, time.time())
+
+    def exemplars(self) -> List[Dict[str, Any]]:
+        """The per-bucket exemplars, ascending by bucket bound: each a
+        ``{le, trace_id, value, ts}`` record — the trace ids a scrape
+        consumer (or an incident bundle reader) follows back to real
+        request traces. Kept OUT of the text exposition on purpose:
+        OpenMetrics exemplar syntax is not valid Prometheus text 0.0.4
+        and would break every existing parse twin; the JSON surfaces
+        (``metrics_snapshot()``, incident bundles) carry them instead."""
+        with self._lock:
+            items = sorted(self._exemplars.items())
+        out = []
+        for i, (t, v, ts) in items:
+            le = self.upper_bound(i)
+            out.append({"le": (le if math.isfinite(le) else None),
+                        "trace_id": t, "value": v, "ts": ts})
+        return out
 
     def merge(self, other: "LogHistogram") -> None:
         """Fold another histogram of the SAME geometry into this one
@@ -623,6 +650,22 @@ class MetricsRegistry:
         return r.total(window_s, now=now) if r is not None else 0.0
 
     # -- introspection -------------------------------------------------
+
+    def exemplar_snapshot(self, name: Optional[str] = None
+                          ) -> Dict[str, List[Dict[str, Any]]]:
+        """Every histogram's exemplars (``name`` filters), keyed the
+        snapshot way — the JSON surface trace ids ride out on (the
+        text exposition stays exemplar-free; see
+        :meth:`LogHistogram.exemplars`)."""
+        with self._lock:
+            hists = [(k, h) for k, h in sorted(self._hists.items())
+                     if name is None or k[0] == name]
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for k, h in hists:
+            ex = h.exemplars()
+            if ex:
+                out[_fmt_key(k)] = ex
+        return out
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

@@ -303,6 +303,13 @@ class TelemetryRun:
         self.n_spans = 0
         self._threads_named: set = set()
 
+    def counters_at_start(self) -> Dict[str, Any]:
+        """The counter-registry snapshot taken when this run attached —
+        the baseline that turns process-LIFETIME counter totals into
+        run-scoped deltas (the run record's ``d`` uses it at finish;
+        the incident bundles use it mid-run, DESIGN.md §21)."""
+        return dict(self._c0)
+
     # -- low-level emission ------------------------------------------
 
     def _next_id(self) -> int:
@@ -434,7 +441,16 @@ def instant(name: str, cat: str = "mark", **args) -> None:
     Emitted to the Chrome-trace stream AND as a zero-duration spans.jsonl
     record, so offline rollups (scripts/trace_report.py — e.g. the
     fold-stack section's per-fold ``fold_stopped`` marks) can read
-    markers without parsing the trace file."""
+    markers without parsing the trace file.
+
+    Every instant ALSO lands in the black-box flight recorder
+    (``utils/flight.py``) — BEFORE the run-active gate, because the
+    recorder's whole point is capturing breaker transitions, fault
+    injections, publishes and quarantines on processes that never
+    attached a run dir (the incident bundles of DESIGN.md §21)."""
+    from lfm_quant_tpu.utils import flight
+
+    flight.note(name, cat, args)
     run = _ACTIVE
     if run is None or not enabled():
         return
@@ -468,6 +484,9 @@ _KNOB_PROBES = (
     # Durable serving state (LFM_ZOO_PERSIST, DESIGN.md §20): whether
     # published zoo generations are journaled to a durable store.
     ("zoo_persist", "lfm_quant_tpu.serve.persist", "persist_enabled"),
+    # Black-box flight recorder (LFM_FLIGHT, DESIGN.md §21): whether
+    # the always-on event ring records (the incident-bundle evidence).
+    ("flight", "lfm_quant_tpu.utils.flight", "enabled"),
 )
 
 
@@ -480,6 +499,60 @@ def _git_sha() -> Optional[str]:
         return out.stdout.strip() or None if out.returncode == 0 else None
     except Exception:
         return None
+
+
+_BUILD_INFO: Optional[Dict[str, Any]] = None
+
+
+def build_info() -> Dict[str, Any]:
+    """Fleet/host identity, cached after first probe: git sha, jax /
+    jaxlib versions, backend, resolved compute dtype, device count,
+    hostname and pid — the ROADMAP item-2 groundwork. One record, two
+    consumers: the ``build_info`` gauge labels on ``/metrics``
+    (serve/monitor.py — how a fleet aggregator tells WHICH build a
+    scrape came from) and the host-identity block stamped into every
+    incident bundle (serve/incident.py). Every probe degrades to None
+    rather than failing a serving process."""
+    global _BUILD_INFO
+    if _BUILD_INFO is not None:
+        info = dict(_BUILD_INFO)
+    else:
+        import socket
+
+        info = {
+            "git_sha": _git_sha(),
+            "host": socket.gethostname(),
+            "pid": os.getpid(),
+            "python": sys.version.split()[0],
+        }
+        _probe_build_env(info)
+        _BUILD_INFO = dict(info)
+    # The precision lane is re-resolved per call (config-over-env, can
+    # flip in-process — the amp lane does); everything above is
+    # process-constant and cached.
+    try:
+        from lfm_quant_tpu.config import resolve_precision
+
+        info["dtype"] = resolve_precision()
+    except Exception:
+        info["dtype"] = None
+    return info
+
+
+def _probe_build_env(info: Dict[str, Any]) -> None:
+    try:
+        import jax
+        import jaxlib
+
+        info["jax"] = jax.__version__
+        info["jaxlib"] = jaxlib.__version__
+        info["backend"] = jax.default_backend()
+        info["device_count"] = len(jax.devices())
+    except Exception:
+        info.setdefault("jax", None)
+        info.setdefault("jaxlib", None)
+        info.setdefault("backend", None)
+        info.setdefault("device_count", None)
 
 
 def build_manifest(config: Any = None,

@@ -147,6 +147,17 @@ def _merged_hist_pairs(entries: List[Any]) -> List[Any]:
     return out
 
 
+def _read_json(path: str):
+    """One JSON document, or None (missing/corrupt — report, don't die)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
 def _read_jsonl(path: str) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     if not os.path.exists(path):
@@ -187,12 +198,45 @@ def load_run(run_dir: str) -> Dict[str, Any]:
         except OSError:
             continue
 
+    # Incident bundles (serve/incident.py, DESIGN.md §21): complete
+    # iff incident.json exists (written last, fsync'd) — half-written
+    # bundles from a crashed capture are skipped, not half-parsed.
+    incidents = []
+    inc_base = os.path.join(run_dir, "incidents")
+    if os.path.isdir(inc_base):
+        for name in sorted(os.listdir(inc_base)):
+            bdir = os.path.join(inc_base, name)
+            ipath = os.path.join(bdir, "incident.json")
+            if not os.path.isfile(ipath):
+                continue
+            meta = _read_json(ipath)
+            if meta is None:
+                continue
+            prom = None
+            ppath = os.path.join(bdir, "metrics.prom")
+            if os.path.isfile(ppath):
+                try:
+                    with open(ppath) as fh:
+                        prom = fh.read()
+                except OSError:
+                    prom = None
+            incidents.append({
+                "dir": bdir,
+                "name": name,
+                "meta": meta,
+                "flight": _read_jsonl(os.path.join(bdir, "flight.jsonl")),
+                "slow": _read_json(os.path.join(bdir,
+                                                "slow_requests.json")),
+                "metrics_text": prom,
+            })
+
     return {
         "run_dir": run_dir,
         "manifest": manifest,
         "spans": _read_jsonl(os.path.join(run_dir, "spans.jsonl")),
         "ledger": _read_jsonl(os.path.join(run_dir, "ledger.jsonl")),
         "metrics_text": metrics_text,
+        "incidents": incidents,
         # First process owns trace.json; later ones (backtest over a
         # train dir) land as trace.<pid>.json — count them all.
         "trace_files": sorted(
@@ -440,6 +484,21 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
             "faults_injected": int(
                 counters.get("faults_injected", 0) or 0),
         }
+        # Slowest-request waterfall (DESIGN.md §21): every completed
+        # serve_request span carries its request_id and the
+        # queue/batch/retry/dispatch phase breakdown the batcher
+        # stamped O(1) — the table answers "where did the p99 request
+        # spend its time" from the run dir alone.
+        phased = [s.get("args", {}) for s in reqs
+                  if "latency_ms" in s.get("args", {})
+                  and "queue_ms" in s.get("args", {})]
+        report["serve"]["slowest"] = [
+            {k: a.get(k) for k in ("request_id", "universe", "month",
+                                   "latency_ms", "queue_ms", "batch_ms",
+                                   "retry_ms", "dispatch_ms", "retries",
+                                   "width")}
+            for a in sorted(phased,
+                            key=lambda a: -a["latency_ms"])[:8]]
     # Durable-restore rollup (serve/persist.py, DESIGN.md §20): restore
     # wall time and per-universe outcomes from the zoo_restore span +
     # restore_generation instants, executables loaded vs recompiled and
@@ -492,6 +551,99 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
             "execs_exported": int(
                 counters.get("persist_execs_exported", 0) or 0),
             "gc_pruned": int(counters.get("persist_gc_pruned", 0) or 0),
+        }
+    # Incident-bundle rollup (serve/incident.py, DESIGN.md §21): which
+    # triggers fired, when, and what each bundle captured — plus two
+    # cross-checks per bundle, both the 1% discipline:
+    #   1. SCRAPE INTEGRITY — the scrape's lfm_*_total lines must
+    #      equal the manifest's counters_at_capture (both rendered
+    #      from ONE snapshot at capture; a torn/forged scrape breaks
+    #      the agreement);
+    #   2. RUN DISCIPLINE — counters_since_run (capture totals minus
+    #      the run's starting snapshot; the registry is process-
+    #      lifetime, so raw totals would false-positive on long-lived
+    #      services) can only be AT MOST the run's final span-derived
+    #      counts — a mid-run capture cannot have seen more events
+    #      than the whole run recorded.
+    if run.get("incidents"):
+        sv = report.get("serve") or {}
+        bundles = []
+        inc_mismatches: List[str] = []
+        for b in run["incidents"]:
+            meta = b["meta"] or {}
+            ring = b["flight"]
+            rec: Dict[str, Any] = {
+                "name": b["name"],
+                "trigger": meta.get("trigger"),
+                "ts": meta.get("ts"),
+                "context": meta.get("context"),
+                "flight_events": len(ring),
+                "slow_traces": (len(b["slow"])
+                                if isinstance(b["slow"], list) else 0),
+                "has_scrape": b["metrics_text"] is not None,
+                "host": (meta.get("host") or {}).get("host"),
+                "git_sha": ((meta.get("host") or {}).get("git_sha")
+                            or "")[:12] or None,
+            }
+            # Timeline: the ring's last events BEFORE the trigger —
+            # the "seconds before the degradation" evidence.
+            rec["timeline"] = [
+                {k: e.get(k) for k in ("ts", "kind", "universe",
+                                       "error", "streak")
+                 if e.get(k) is not None}
+                for e in ring[-6:]]
+            cap = meta.get("counters_at_capture") or {}
+            since = meta.get("counters_since_run")
+            checked = ("serve_shed", "serve_deadline_drops",
+                       "serve_retries", "serve_breaker_opens",
+                       "serve_batches")
+            if b["metrics_text"] and cap:
+                prom = _parse_prom(b["metrics_text"])
+                for cname in checked:
+                    vals = prom.get(f"lfm_{cname}_total")
+                    manifest_v = cap.get(cname)
+                    if vals is None and not manifest_v:
+                        continue  # absent both sides: never bumped
+                    scraped = (int(sum(v for _, v in vals))
+                               if vals else 0)
+                    manifest_v = int(manifest_v or 0)
+                    tol = max(1.0, 0.01 * abs(manifest_v))
+                    if abs(scraped - manifest_v) > tol:
+                        inc_mismatches.append(
+                            f"{b['name']}: {cname}: scrape total "
+                            f"{scraped} vs the bundle manifest's "
+                            f"counters_at_capture {manifest_v} (>1% — "
+                            "both came from ONE snapshot; the scrape "
+                            "is torn or forged)")
+            if since and sv:
+                for key, cname in (("shed", "serve_shed"),
+                                   ("deadline_drops",
+                                    "serve_deadline_drops"),
+                                   ("retries", "serve_retries"),
+                                   ("breaker_opens",
+                                    "serve_breaker_opens")):
+                    run_v = since.get(cname)
+                    spans_v = sv.get(key)
+                    if run_v is None or spans_v is None:
+                        continue
+                    tol = max(1.0, 0.01 * abs(spans_v))
+                    if run_v - spans_v > tol:
+                        inc_mismatches.append(
+                            f"{b['name']}: {key}: bundle "
+                            f"counters_since_run {run_v} exceeds the "
+                            f"run's span-derived total {spans_v} (>1% "
+                            "— a mid-run capture cannot have seen "
+                            "more than the full run)")
+            bundles.append(rec)
+        report["incidents"] = {
+            "bundles": bundles,
+            "count": len(bundles),
+            "triggered": int(counters.get("incidents_triggered", 0)
+                             or 0),
+            "captured": int(counters.get("incidents_captured", 0) or 0),
+            "suppressed": int(counters.get("incidents_suppressed", 0)
+                              or 0),
+            "mismatches": inc_mismatches,
         }
     # Live-metrics cross-check (the /metrics scrape vs the spans — the
     # pull-side plane and the post-hoc plane must tell the same story):
@@ -684,6 +836,21 @@ def print_report(rep: Dict[str, Any]) -> None:
                   f"retries {sv.get('retries', 0)}  "
                   f"breaker_opens {sv.get('breaker_opens', 0)}  "
                   f"faults_injected {sv.get('faults_injected', 0)}")
+        slowest = sv.get("slowest") or []
+        if slowest:
+            print("  slowest requests (phase waterfall, ms):")
+            print(f"    {'request_id':<18} {'total':>8} {'queue':>7} "
+                  f"{'batch':>7} {'retry':>7} {'disp':>7} {'rt':>3}  "
+                  f"universe/month")
+            for a in slowest[:5]:
+                rid = str(a.get("request_id") or "?")[:16]
+                print(f"    {rid:<18} {a.get('latency_ms', 0):>8.2f} "
+                      f"{a.get('queue_ms', 0):>7.2f} "
+                      f"{a.get('batch_ms', 0):>7.2f} "
+                      f"{a.get('retry_ms', 0):>7.2f} "
+                      f"{a.get('dispatch_ms', 0):>7.2f} "
+                      f"{a.get('retries', 0):>3}  "
+                      f"{a.get('universe')}/{a.get('month')}")
     rs = rep.get("restore")
     if rs:
         if rs.get("restores"):
@@ -699,6 +866,26 @@ def print_report(rep: Dict[str, Any]) -> None:
             print(f"persist     : {rs['commits']} commit(s)  "
                   f"execs exported {rs['execs_exported']}  "
                   f"gc pruned {rs['gc_pruned']}")
+    inc = rep.get("incidents")
+    if inc:
+        print(f"incidents   : {inc['count']} bundle(s)  "
+              f"triggered {inc['triggered']}  captured {inc['captured']}"
+              f"  suppressed {inc['suppressed']}")
+        for b in inc["bundles"]:
+            print(f"  {b['name']}: trigger={b['trigger']} at {b['ts']}  "
+                  f"flight_events={b['flight_events']}  "
+                  f"slow_traces={b['slow_traces']}  "
+                  f"scrape={'yes' if b['has_scrape'] else 'MISSING'}  "
+                  f"host={b.get('host')}")
+            tl = b.get("timeline") or []
+            if tl:
+                tail = "; ".join(
+                    str(e.get("kind")) + (f"({e['error']})"
+                                          if e.get("error") else "")
+                    for e in tl)
+                print(f"    timeline … {tail}")
+        for msg in inc.get("mismatches") or []:
+            print(f"  INCIDENT MISMATCH: {msg}")
     mx = rep.get("metrics")
     if mx:
         p99 = mx.get("p99_ms")

@@ -1,0 +1,553 @@
+"""Incident lane (``-m incident``): request tracing, the black-box
+flight recorder, and automatic incident capture (DESIGN.md §21).
+
+* **Request-scoped tracing** — every request gets (or propagates, via
+  ``X-Request-Id`` / ``traceparent``) a trace id that rides submit →
+  queue → coalesced batch → dispatch → response, with an O(1)
+  queue/batch/retry/dispatch phase breakdown echoed in the response,
+  the ``serve_request`` span, the access log and the histogram
+  exemplars — pinned end to end including a REAL HTTP round trip.
+* **Flight recorder** (``utils/flight.py``) — always-on, lock-guarded,
+  allocation-bounded ring of the last ~N structured events; hammered
+  from many threads (exact counts, no torn lines), dumped crash-safely,
+  fed by ``telemetry.instant`` with NO active run (the black-box
+  property).
+* **Automatic incident capture** (``serve/incident.py``) — forced
+  triggers through the ``LFM_FAULTS`` harness (breaker open, snapshot
+  quarantine) each produce EXACTLY ONE rate-limited bundle under the
+  cooldown, containing the ring, a valid ``/metrics`` scrape, ≥1
+  slow-request trace with phases, and host/build identity —
+  ``scripts/trace_report.py`` parses it loudly-clean.
+* **Non-interference re-measured** with the recorder fully on: a warm
+  fit pays zero jit traces / zero panel H2D / one host sync per epoch,
+  and serving steady state pays zero/zero.
+
+Module named early in the alphabet on purpose: it must sort before the
+tier-1 timebox cut (ROADMAP tier-1 notes).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from lfm_quant_tpu.config import DataConfig, ModelConfig, OptimConfig, RunConfig
+from lfm_quant_tpu.data import synthetic_panel
+from lfm_quant_tpu.data.panel import PanelSplits
+from lfm_quant_tpu.data.windows import clear_panel_cache
+from lfm_quant_tpu.serve import ScoringService
+from lfm_quant_tpu.serve import incident as incident_mod
+from lfm_quant_tpu.serve.batcher import clean_request_id, new_request_id
+from lfm_quant_tpu.train import reuse
+from lfm_quant_tpu.train.loop import Trainer
+from lfm_quant_tpu.utils import faults, flight, telemetry
+from lfm_quant_tpu.utils.metrics import METRICS
+from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+pytestmark = pytest.mark.incident
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cfg(n_firms=48, window=6, seed=0, epochs=1, name="incident_t"):
+    return RunConfig(
+        name=name,
+        data=DataConfig(n_firms=n_firms, n_months=140, n_features=4,
+                        window=window, dates_per_batch=4,
+                        firms_per_date=24),
+        model=ModelConfig(kind="mlp", kwargs={"hidden": (8,)}),
+        optim=OptimConfig(lr=1e-3, epochs=epochs, warmup_steps=2,
+                          loss="mse"),
+        seed=seed,
+    )
+
+
+def _universe(seed=0, panel_seed=5, fit=False):
+    panel = synthetic_panel(n_firms=48, n_months=140, n_features=4,
+                            seed=panel_seed)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    tr = Trainer(_cfg(seed=seed), splits)
+    if fit:
+        tr.fit()
+    else:
+        tr.state = tr.init_state()
+    return tr
+
+
+def _trace_report():
+    """Import scripts/trace_report.py the stats.py way (no package)."""
+    from lfm_quant_tpu.serve.stats import load_trace_report
+
+    return load_trace_report(REPO)
+
+
+@pytest.fixture(autouse=True)
+def _incident_hygiene(monkeypatch):
+    """Default knob state, fresh ring/registry/caches — in AND out (the
+    chaos-lane hygiene pattern)."""
+    for knob in ("LFM_FLIGHT", "LFM_INCIDENT_DIR",
+                 "LFM_INCIDENT_COOLDOWN_S", "LFM_ACCESS_LOG",
+                 "LFM_FAULTS", "LFM_METRICS"):
+        monkeypatch.delenv(knob, raising=False)
+    faults.configure("")
+    flight.configure()
+    METRICS.reset()
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    yield
+    faults.configure("")
+    flight.configure()
+    METRICS.reset()
+    reuse.clear_program_cache()
+    clear_panel_cache()
+
+
+# ---- flight recorder -----------------------------------------------------
+
+
+def test_flight_ring_bounded_ordered_and_dumpable(tmp_path):
+    rec = flight.FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record("ev", cat="t", i=i)
+    snap = rec.snapshot()
+    assert len(snap) == 8                       # bounded
+    assert [e["i"] for e in snap] == list(range(12, 20))  # newest kept
+    assert [e["seq"] for e in snap] == list(range(13, 21))
+    st = rec.stats()
+    assert st["total_seen"] == 20 and st["dropped"] == 12
+    # Crash-safe dump: strict JSON lines, atomic replace (non-finite
+    # floats nulled — the spans.jsonl policy).
+    rec.record("weird", cat="t", bad=float("nan"))
+    path = str(tmp_path / "flight.jsonl")
+    n = rec.dump(path)
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert len(lines) == n == 8
+    assert lines[-1]["kind"] == "weird" and lines[-1]["bad"] is None
+
+
+def test_flight_knob_routing(monkeypatch):
+    assert flight.flight_capacity() == flight.DEFAULT_CAPACITY
+    monkeypatch.setenv("LFM_FLIGHT", "0")
+    assert flight.configure() is None
+    assert not flight.enabled()
+    flight.record("nope")                        # exact no-op
+    assert flight.snapshot() == []
+    monkeypatch.setenv("LFM_FLIGHT", "64")
+    rec = flight.configure()
+    assert rec is not None and rec.capacity == 64
+    monkeypatch.setenv("LFM_FLIGHT", "bogus")
+    with pytest.raises(ValueError, match="LFM_FLIGHT"):
+        flight.configure()
+    # Clean BEFORE the hygiene teardown re-reads the env (its
+    # configure() would re-raise on the planted garbage).
+    monkeypatch.delenv("LFM_FLIGHT")
+    flight.configure()
+
+
+def test_flight_multithreaded_hammer_exact_counts_no_torn_lines(tmp_path):
+    """N writer threads × M events each: every event lands exactly once
+    (a capacity above N×M), the ring never exceeds its bound under a
+    small capacity, and a dump mid-hammer parses line-for-line."""
+    n_threads, n_events = 8, 400
+    rec = flight.FlightRecorder(capacity=n_threads * n_events + 1)
+
+    def writer(tid):
+        for k in range(n_events):
+            rec.record("hammer", cat="t", tid=tid, k=k)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = rec.snapshot()
+    assert len(snap) == n_threads * n_events
+    seen = {(e["tid"], e["k"]) for e in snap}
+    assert len(seen) == n_threads * n_events     # exact, no loss
+    assert [e["seq"] for e in snap] == sorted(e["seq"] for e in snap)
+    # Bounded ring under the same hammer: capacity is the hard cap.
+    small = flight.FlightRecorder(capacity=64)
+    threads = [threading.Thread(target=lambda t=t: [
+        small.record("h", tid=t, k=k) for k in range(n_events)])
+        for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    dumped = []
+    for _ in range(5):                           # dump DURING the hammer
+        p = str(tmp_path / "mid.jsonl")
+        small.dump(p)
+        dumped.append([json.loads(x)
+                       for x in open(p).read().splitlines()])
+    for t in threads:
+        t.join()
+    assert len(small.snapshot()) == 64
+    for lines in dumped:                         # no torn lines, ever
+        assert len(lines) <= 64
+        assert all("kind" in e and "seq" in e for e in lines)
+
+
+def test_instants_land_in_ring_without_active_run():
+    """The black-box property: breaker transitions / fault injections /
+    publishes are telemetry INSTANTS, and instants feed the ring even
+    when no telemetry run dir is attached (where PR 4 spans go
+    nowhere)."""
+    assert telemetry._ACTIVE is None
+    rec = flight.configure()
+    telemetry.instant("circuit_open", cat="serve", streak=3)
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert "circuit_open" in kinds
+    ev = rec.snapshot()[-1]
+    assert ev["streak"] == 3 and ev["cat"] == "serve"
+
+
+# ---- request-scoped tracing ---------------------------------------------
+
+
+def test_request_id_hygiene():
+    assert len(new_request_id()) == 32
+    assert new_request_id() != new_request_id()
+    assert clean_request_id(None) is None
+    assert clean_request_id("") is None
+    assert clean_request_id("  ok-id_1.2  ") == "ok-id_1.2"
+    # Hostile header: control/quote/shell characters stripped (only
+    # alnum and -_. survive), length capped at 64.
+    assert clean_request_id('x"\n;rm -rf<y>' + "z" * 100) == \
+        "xrm-rfy" + "z" * 57
+    assert len(clean_request_id("a" * 500)) == 64
+
+
+def test_request_ids_and_phase_breakdown_end_to_end(tmp_path):
+    """Trace identity + phases through the REAL service: propagated and
+    minted ids echo in the response, the span record, the slow-trace
+    tracker and the histogram exemplars; phases sum to ~latency; and
+    serving steady state stays zero-trace/zero-H2D with the recorder
+    and tracing fully on."""
+    run_dir = str(tmp_path / "run")
+    assert telemetry._ACTIVE is None
+    svc = ScoringService(max_rows=4, max_wait_ms=1.0)
+    try:
+        svc.register("u0", _universe())
+        months = svc.serveable_months("u0")
+        svc.score("u0", months[0])               # warm D2H paths
+        snap = REUSE_COUNTERS.snapshot()
+        with telemetry.run_scope(run_dir, extra={"entry": "test"}):
+            r = svc.score("u0", months[1], request_id="trace-me-7")
+            auto = svc.score("u0", months[2])
+        d = REUSE_COUNTERS.delta(snap)
+        assert d.get("jit_traces", 0) == 0, d
+        assert d.get("panel_transfers", 0) == 0, d
+        assert r.request_id == "trace-me-7"
+        assert len(auto.request_id) == 32        # minted
+        for resp in (r, auto):
+            p = resp.phases
+            for k in ("queue_ms", "batch_ms", "retry_ms", "dispatch_ms",
+                      "retries", "width"):
+                assert k in p, p
+            total = (p["queue_ms"] + p["batch_ms"] + p["retry_ms"]
+                     + p["dispatch_ms"])
+            assert total == pytest.approx(resp.latency_ms, abs=1.0)
+            assert p["retries"] == 0
+        # The slow-trace tracker holds both, with their ids and phases.
+        slow = svc.batcher.slow_traces()
+        by_id = {t["request_id"]: t for t in slow}
+        assert "trace-me-7" in by_id
+        assert by_id["trace-me-7"]["dispatch_ms"] >= 0
+        # Exemplars: some latency bucket points at a real trace id.
+        ex = METRICS.exemplar_snapshot("serve_latency_ms")
+        ids = {e["trace_id"] for v in ex.values() for e in v}
+        assert "trace-me-7" in ids or auto.request_id in ids
+        # The span record carries the same id + phases (the waterfall's
+        # source), and trace_report surfaces the slowest table.
+        spans = [json.loads(x) for x in
+                 open(os.path.join(run_dir, "spans.jsonl"))]
+        req_spans = [s for s in spans if s.get("name") == "serve_request"]
+        args = {s["args"]["request_id"]: s["args"] for s in req_spans}
+        assert "trace-me-7" in args
+        assert args["trace-me-7"]["queue_ms"] >= 0
+    finally:
+        svc.close()
+    tr = _trace_report()
+    rep = tr.build_report(tr.load_run(run_dir))
+    slowest = rep["serve"]["slowest"]
+    assert slowest and "trace-me-7" in {a["request_id"] for a in slowest}
+    for a in slowest:
+        for k in ("queue_ms", "batch_ms", "retry_ms", "dispatch_ms"):
+            assert a[k] is not None
+
+
+def test_http_header_round_trip_and_access_log(tmp_path, monkeypatch):
+    """A REAL HTTP round trip: X-Request-Id propagates into the served
+    response (header + body), traceparent's trace-id field is
+    extracted, and the knob-gated access log writes one strict-JSON
+    line per request with id, routing, status and phases."""
+    import serve as serve_mod
+
+    log_path = str(tmp_path / "access.jsonl")
+    monkeypatch.setenv("LFM_ACCESS_LOG", log_path)
+    svc = ScoringService(max_rows=4, max_wait_ms=1.0)
+    httpd = None
+    try:
+        svc.register("u0", _universe())
+        m = svc.serveable_months("u0")[3]
+        httpd = serve_mod.make_http_server(svc, 0)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/score?universe=u0&month={m}",
+            headers={"X-Request-Id": "hdr-rt-1"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert resp.headers.get("X-Request-Id") == "hdr-rt-1"
+            body = json.load(resp)
+        assert body["request_id"] == "hdr-rt-1"
+        assert body["phases"]["dispatch_ms"] >= 0
+
+        tp = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/score?universe=u0&month={m}",
+            headers={"traceparent": tp})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert (resp.headers.get("X-Request-Id")
+                    == "0af7651916cd43dd8448eb211c80319c")
+
+        # No header: the service MINTS an id and still echoes it.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/score?universe=u0&month={m}",
+                timeout=30) as resp:
+            assert len(resp.headers.get("X-Request-Id")) == 32
+
+        lines = [json.loads(x)
+                 for x in open(log_path).read().splitlines()]
+        assert len(lines) == 3
+        assert lines[0]["request_id"] == "hdr-rt-1"
+        for rec in lines:
+            for k in ("ts", "request_id", "universe", "month", "status",
+                      "bucket", "queue_ms", "dispatch_ms", "retries"):
+                assert k in rec, rec
+            assert rec["status"] == 200
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        svc.close()
+    # Knob off (default): not a line is written.
+    monkeypatch.delenv("LFM_ACCESS_LOG")
+    serve_mod.access_log({"should": "not appear"})
+    assert len(open(log_path).read().splitlines()) == 3
+
+
+# ---- automatic incident capture -----------------------------------------
+
+
+def test_incident_cooldown_dir_resolution_and_rate_limit(tmp_path):
+    svc = ScoringService(max_rows=2, max_wait_ms=0.5)
+    try:
+        inc = svc.incidents
+        # No explicit dir, no env, no active run → capture disabled.
+        assert inc.resolve_dir() is None
+        assert inc.trigger("breaker_open", sync=True) is False
+        inc._dir = str(tmp_path / "inc")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            assert inc.trigger("breaker_open", sync=True, streak=2)
+            # Same trigger inside the cooldown: suppressed.
+            assert inc.trigger("breaker_open", sync=True) is False
+            # A DIFFERENT trigger kind has its own cooldown clock.
+            assert inc.trigger("slo_burn", sync=True, max_burn=2.0)
+        assert inc.captured == 2 and inc.suppressed == 1
+        bundles = incident_mod.find_bundles(str(tmp_path / "inc"))
+        assert len(bundles) == 2
+        # snapshot() surfaces the tallies (the /stats view).
+        assert svc.snapshot()["stats"]["incidents"] == {
+            "captured": 2, "suppressed": 1}
+    finally:
+        svc.close()
+
+
+def test_forced_breaker_open_produces_exactly_one_bundle(tmp_path):
+    """THE acceptance pin: a forced breaker-open (LFM_FAULTS transient
+    dispatch schedule, retries exhausted) under load produces exactly
+    ONE rate-limited bundle containing the flight ring, a VALID
+    /metrics scrape, and ≥1 slow-request trace with the
+    queue/batch/dispatch phase breakdown; trace_report parses it
+    loudly-clean; a second breaker-open inside the cooldown adds no
+    bundle."""
+    from concurrent.futures import wait as fwait
+
+    run_dir = str(tmp_path / "run")
+    assert telemetry._ACTIVE is None
+    with telemetry.run_scope(run_dir, extra={"entry": "test_incident"}):
+        svc = ScoringService(max_rows=4, max_wait_ms=1.0, retries=0,
+                             breaker_threshold=2,
+                             breaker_cooldown_ms=30.0)
+        try:
+            svc.register("u0", _universe())
+            months = svc.serveable_months("u0")
+            for m in months[:6]:                 # healthy traffic first
+                svc.score("u0", m)
+            faults.configure("serve_dispatch:kind=transient,n=2")
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                futs = [svc.submit("u0", months[i % len(months)])
+                        for i in range(6)]
+                fwait(futs, timeout=30)
+                svc.incidents.wait()
+            faults.configure("")
+            assert svc.batcher.stats()["breaker_opens"] >= 1
+            bundles = incident_mod.find_bundles(run_dir)
+            assert len(bundles) == 1, bundles
+            bdir = bundles[0]
+
+            meta = json.load(open(os.path.join(bdir, "incident.json")))
+            assert meta["trigger"] == "breaker_open"
+            assert meta["host"]["host"] and meta["host"]["pid"]
+            assert meta["host"]["backend"] is not None
+
+            ring = [json.loads(x) for x in
+                    open(os.path.join(bdir, "flight.jsonl"))]
+            kinds = {e["kind"] for e in ring}
+            assert "circuit_open" in kinds       # the causal moment
+            assert "fault_injected" in kinds     # ...and its cause
+            assert "dispatch" in kinds           # healthy traffic before
+
+            # The scrape is VALID 0.0.4: the package parser and the
+            # trace_report twin agree on it, and it carries the serve
+            # families.
+            doc = open(os.path.join(bdir, "metrics.prom")).read()
+            from lfm_quant_tpu.utils.metrics import parse_prometheus
+
+            tr = _trace_report()
+            prom_a = parse_prometheus(doc)
+            prom_b = tr._parse_prom(doc)
+            assert prom_a == prom_b
+            assert "lfm_serve_latency_ms_count" in prom_a
+            assert "lfm_build_info" in prom_a
+            info_labels = prom_a["lfm_build_info"][0][0]
+            assert info_labels["backend"] and info_labels["git_sha"]
+
+            slow = json.load(open(os.path.join(bdir,
+                                               "slow_requests.json")))
+            assert len(slow) >= 1
+            for t in slow:
+                for k in ("request_id", "queue_ms", "batch_ms",
+                          "dispatch_ms", "latency_ms"):
+                    assert k in t, t
+
+            # Exemplars point at real trace ids from the slow set's
+            # stream (same histogram, same ids).
+            ex = json.load(open(os.path.join(bdir, "exemplars.json")))
+            assert any(v for v in ex.values())
+
+            # Second forced breaker-open INSIDE the cooldown: the
+            # breaker opens again, the capture is suppressed.
+            time.sleep(0.1)                      # past the breaker
+            faults.configure("serve_dispatch:kind=transient,n=2")
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                futs = [svc.submit("u0", months[i % len(months)])
+                        for i in range(6)]
+                fwait(futs, timeout=30)
+                svc.incidents.wait()
+            faults.configure("")
+            assert svc.batcher.stats()["breaker_opens"] >= 2
+            assert len(incident_mod.find_bundles(run_dir)) == 1
+            assert svc.incidents.suppressed >= 1
+        finally:
+            svc.close()
+    # trace_report: loudly-clean — one bundle, its trigger, a timeline,
+    # and NO mismatch lines (the bundle's mid-run scrape totals are
+    # inside the 1% discipline against the run's span-derived counts).
+    tr = _trace_report()
+    rep = tr.build_report(tr.load_run(run_dir))
+    inc = rep["incidents"]
+    assert inc["count"] == 1
+    assert inc["bundles"][0]["trigger"] == "breaker_open"
+    assert inc["bundles"][0]["flight_events"] > 0
+    assert inc["bundles"][0]["slow_traces"] >= 1
+    assert inc["bundles"][0]["timeline"]
+    assert inc["mismatches"] == []
+    assert rep["serve"]["breaker_opens"] >= 2
+    # Forge the bundle's scrape (a shed total the capture snapshot
+    # never recorded): the scrape-integrity cross-check must go LOUD,
+    # not quietly average it away.
+    forged = os.path.join(incident_mod.find_bundles(run_dir)[0],
+                          "metrics.prom")
+    doc = open(forged).read().replace(
+        "lfm_serve_shed_total", "lfm_ignored_total") \
+        + "\nlfm_serve_shed_total 999999\n"
+    open(forged, "w").write(doc)
+    rep2 = tr.build_report(tr.load_run(run_dir))
+    assert any("serve_shed" in m and "forged" in m
+               for m in rep2["incidents"]["mismatches"])
+
+
+def test_quarantine_trigger_produces_exactly_one_bundle(tmp_path):
+    """The durable-state trigger: a snapshot failing restore
+    verification (tampered params checksum) quarantines AND captures
+    exactly one incident bundle."""
+    store_dir = str(tmp_path / "store")
+    inc_dir = str(tmp_path / "inc")
+    svc = ScoringService(max_rows=2, max_wait_ms=0.5,
+                         persist_dir=store_dir, incident_dir=inc_dir)
+    try:
+        svc.register("us", _universe())
+    finally:
+        svc.close()
+    reuse.clear_program_cache()
+    clear_panel_cache()
+    # Tamper: flip the committed params checksum (the durable-lane
+    # idiom) — restore must quarantine, and the quarantine must
+    # trigger a capture.
+    mpath = os.path.join(store_dir, "manifest.json")
+    m = json.load(open(mpath))
+    m["universes"]["us"]["generations"][-1]["params_sha256"] = "0" * 64
+    json.dump(m, open(mpath, "w"))
+    svc2 = ScoringService(max_rows=2, max_wait_ms=0.5,
+                          persist_dir=store_dir, incident_dir=inc_dir)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            assert svc2.restore() == []
+            svc2.incidents.wait()
+        bundles = incident_mod.find_bundles(inc_dir)
+        assert len(bundles) == 1
+        meta = json.load(open(os.path.join(bundles[0], "incident.json")))
+        assert meta["trigger"] == "quarantine"
+        assert "reason" in meta["context"]
+        # The ring captured the quarantine instant itself.
+        ring = [json.loads(x) for x in
+                open(os.path.join(bundles[0], "flight.jsonl"))]
+        assert "restore_quarantine" in {e["kind"] for e in ring}
+    finally:
+        svc2.close()
+
+
+def test_fit_non_interference_with_recorder_fully_on(monkeypatch):
+    """The measured contract re-pinned with THIS PR's layer on: flight
+    recorder recording, incident manager constructed — a warm fit
+    still pays zero jit traces, zero panel H2D, one host sync per
+    epoch."""
+    assert flight.enabled()
+    panel = synthetic_panel(n_firms=48, n_months=140, n_features=4,
+                            seed=5)
+    splits = PanelSplits.by_date(panel, 197801, 198001)
+    tr = Trainer(_cfg(epochs=2), splits)
+    tr.fit()                                     # cold
+    snap = REUSE_COUNTERS.snapshot()
+    ring_before = len(flight.snapshot())
+    tr.rebind()
+    out = tr.fit()                               # warm
+    d = REUSE_COUNTERS.delta(snap)
+    assert d.get("jit_traces", 0) == 0, d
+    assert d.get("panel_transfers", 0) == 0, d
+    assert d.get("host_syncs", 0) == out["epochs_run"], d
+    # The recorder was LIVE through the fit (instants land), i.e. the
+    # zero-interference numbers above were measured with it on.
+    assert flight.recorder() is not None
+    assert len(flight.snapshot()) >= ring_before
