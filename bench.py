@@ -1786,6 +1786,181 @@ def bench_serve_restart() -> None:
     _emit("serve_restart", ratio, 0.0, **extras)
 
 
+def bench_fleet_failover() -> None:
+    """fleet_failover — what the fleet layer (serve/fleet.py, DESIGN.md
+    §22) buys through a member crash: goodput (correct responses/sec)
+    and p99 latency while one of two subprocess members is SIGKILLed
+    mid-traffic, vs the single-member baseline over the same store
+    artifact. One HARD gate before the row records: ZERO incorrect
+    responses (every response through the kill bit-equal to the
+    published generation's reference — all members restored from one
+    probe-verified store) and ZERO client errors (an open-circuit or
+    dead member must be a reroute, not an error — the row raises
+    otherwise). The value is kill-phase goodput as a fraction of the
+    single-member baseline (1.0 = the crash was free); p99 through the
+    kill bounds the failover latency. CPU fallback per the
+    wedged-tunnel protocol — the metric prices the ROUTING layer, not
+    chips."""
+    import shutil
+    import signal
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    import serve as serve_mod
+    from lfm_quant_tpu.data.windows import clear_panel_cache
+    from lfm_quant_tpu.serve import (FleetCoordinator, FleetRouter,
+                                     HttpMember, ScoringService, ZooStore)
+    from lfm_quant_tpu.serve import fleet as fleet_mod
+    from lfm_quant_tpu.train import reuse
+
+    n_requests = int(os.environ.get("LFM_BENCH_FLEET_REQUESTS", "120"))
+    n_threads = int(os.environ.get("LFM_BENCH_FLEET_THREADS", "4"))
+    rtt = dispatch_rtt_ms()
+    store_dir = tempfile.mkdtemp(prefix="lfm_fleet_store_")
+    procs = []
+    try:
+        # Publish ONE universe to the store (the deploy artifact both
+        # members bootstrap from), keep the reference scores.
+        svc = ScoringService(persist_dir=store_dir)
+        name, (trainer, _) = next(iter(serve_mod.build_universes(
+            1, train_epochs=1).items()))
+        svc.register(name, trainer)
+        months = svc.serveable_months(name)[:16]
+        refs = {m: svc.score(name, m).scores.copy() for m in months}
+        svc.close()
+        reuse.clear_program_cache()
+        clear_panel_cache()
+
+        specs = []
+        for k in range(2):
+            rf = os.path.join(store_dir, f"_ready{k}.json")
+            specs.append((fleet_mod.spawn_member(
+                store_dir, ready_file=rf,
+                env={"LFM_ZOO_PERSIST": ""}), rf))
+        infos = [fleet_mod.wait_member_ready(p, rf, 240)
+                 for p, rf in specs]
+        procs = [p for p, _ in specs]
+        restore_compiles = sum(i["restore_compiles"] for i in infos)
+        coord = FleetCoordinator(store=ZooStore(store_dir,
+                                                readonly=True))
+        members = []
+        for k, info in enumerate(infos):
+            hm = HttpMember(f"m{k}",
+                            f"http://127.0.0.1:{info['port']}",
+                            pid=info["pid"])
+            coord.add_member(hm)
+            members.append(hm)
+        router = FleetRouter(coord, breaker=1, cooldown_ms=300,
+                             retries=3)
+        for m in months:  # settle: every bucket warm on both members
+            router.score(name, m)
+
+        def drive(phase_router, kill_at=None, kill_pid=None):
+            lats, errors, incorrect = [], [], [0]
+            done = [0]
+            lock = threading.Lock()
+
+            def client(cid):
+                rng = np.random.default_rng(cid)
+                while True:
+                    with lock:
+                        if done[0] >= n_requests:
+                            return
+                        done[0] += 1
+                        k = done[0]
+                    if kill_at is not None and k == kill_at:
+                        os.kill(kill_pid, signal.SIGKILL)
+                    m = months[int(rng.integers(len(months)))]
+                    t0 = time.perf_counter()
+                    try:
+                        r = phase_router.score(name, m)
+                        lats.append(
+                            (time.perf_counter() - t0) * 1e3)
+                        if not np.array_equal(r.scores, refs[m]):
+                            with lock:
+                                incorrect[0] += 1
+                    except Exception as e:  # noqa: BLE001 — gated below
+                        errors.append(f"{type(e).__name__}: {e}")
+
+            threads = [threading.Thread(target=client, args=(c,),
+                                        daemon=True)
+                       for c in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0, lats, errors, incorrect[0]
+
+        # Baseline: one member behind the router (the degenerate
+        # fleet), same store, same traffic.
+        coord1 = FleetCoordinator(store=ZooStore(store_dir,
+                                                 readonly=True))
+        coord1.add_member(HttpMember(
+            "solo", f"http://127.0.0.1:{infos[0]['port']}",
+            pid=infos[0]["pid"]))
+        router1 = FleetRouter(coord1, retries=1)
+        base_wall, base_lats, base_errors, base_bad = drive(router1)
+        if base_errors or base_bad:
+            raise RuntimeError(
+                f"fleet baseline phase failed: {base_bad} incorrect, "
+                f"{len(base_errors)} errors ({base_errors[:3]})")
+
+        # Kill phase: SIGKILL the universe's PRIMARY a third of the
+        # way in, under concurrent traffic.
+        victim = coord.route(name)[0]
+        vk = int(victim[1:])
+        kill_wall, kill_lats, kill_errors, kill_bad = drive(
+            router, kill_at=max(2, n_requests // 3),
+            kill_pid=procs[vk].pid)
+        if kill_bad or kill_errors:
+            raise RuntimeError(
+                "refusing to record a fleet row with incorrect or "
+                f"failed responses through the kill: {kill_bad} "
+                f"incorrect, {len(kill_errors)} errors "
+                f"({kill_errors[:3]})")
+        stats = router.stats()
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    from lfm_quant_tpu.serve.stats import percentile
+
+    goodput_base = len(base_lats) / max(base_wall, 1e-9)
+    goodput_kill = len(kill_lats) / max(kill_wall, 1e-9)
+    ratio = goodput_kill / max(goodput_base, 1e-9)
+    if restore_compiles > 0:
+        print(f"[bench] WARNING: fleet members paid {restore_compiles} "
+              "restore compile(s) — store bootstrap should load "
+              "serialized executables (contract: 0)", file=sys.stderr,
+              flush=True)
+    extras = {
+        "unit": "x_goodput_through_kill_vs_single_member",
+        "goodput_base_rps": round(goodput_base, 1),
+        "goodput_kill_rps": round(goodput_kill, 1),
+        "p99_base_ms": round(percentile(base_lats, 99.0) or 0.0, 2),
+        "p99_kill_ms": round(percentile(kill_lats, 99.0) or 0.0, 2),
+        "p50_kill_ms": round(percentile(kill_lats, 50.0) or 0.0, 2),
+        "incorrect_responses": 0,
+        "client_errors": 0,
+        "reroutes": stats.get("rerouted"),
+        "failovers": stats.get("failovers"),
+        "restore_compiles": restore_compiles,
+        "n_requests": n_requests,
+        "n_threads": n_threads,
+    }
+    if rtt is not None:
+        extras["rtt_ms"] = rtt
+    _emit("fleet_failover", ratio, 0.0, **extras)
+
+
 def bench_epoch_pipeline() -> None:
     """epoch_pipeline — the async training-loop metric: epochs/hour on a
     CHECKPOINT-ENABLED multi-epoch fit with the one-epoch-lookahead
@@ -2250,7 +2425,8 @@ def main() -> int:
                              "--config-sweep", "--bucketed-train",
                              "--mixed-precision", "--scoring-pipeline",
                              "--epoch-pipeline", "--serve",
-                             "--serve-degradation", "--serve-restart"):
+                             "--serve-degradation", "--serve-restart",
+                             "--fleet-failover"):
                     _cpu_metric_fallback(
                         flag,
                         deadline_s - (time.monotonic() - t_start) - 30.0)
@@ -2358,6 +2534,14 @@ def main() -> int:
             _emit_status("bench_error", stage="serve_restart",
                          detail=f"{type(e).__name__}: {e}"[:300])
             return 1
+        try:
+            bench_fleet_failover()
+        except Exception as e:  # noqa: BLE001 — earlier rows must still reach the driver
+            print(f"bench_fleet_failover failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            _emit_status("bench_error", stage="fleet_failover",
+                         detail=f"{type(e).__name__}: {e}"[:300])
+            return 1
         return 0
     except Exception as e:  # noqa: BLE001 — NO exit path may skip the record
         _emit_status("bench_error", stage="harness",
@@ -2412,6 +2596,9 @@ if __name__ == "__main__":
     if "--serve-restart" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_serve_restart,
                                      "serve_restart"))
+    if "--fleet-failover" in sys.argv[1:]:
+        sys.exit(_single_metric_main(bench_fleet_failover,
+                                     "fleet_failover"))
     if "--serve" in sys.argv[1:]:
         sys.exit(_single_metric_main(bench_serve, "serve"))
     sys.exit(main())

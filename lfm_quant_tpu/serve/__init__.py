@@ -29,21 +29,42 @@ package serves the same compiled programs to live traffic:
                snapshot, slowest request traces, host identity
   stats.py   — pure-python latency percentiles shared with bench and
                mirrored in scripts/trace_report.py
+  fleet.py   — fleet-ready serving (DESIGN.md §22): FleetCoordinator
+               (member registry, consistent (universe, generation) →
+               member routing with replication, store-manifest publish
+               fence, store-bootstrapped join/promotion gate) +
+               FleetRouter (health-aware failover front door — an
+               open-circuit or dead member is a reroute, not an
+               error) + the subprocess member entry
+               (``python -m lfm_quant_tpu.serve.fleet``)
 
 Entry point: ``serve.py`` at the repo root. Knobs: ``LFM_SERVE_ZOO``,
 ``LFM_SERVE_MAX_ROWS``, ``LFM_SERVE_MAX_WAIT_MS``, ``LFM_ZOO_PERSIST``,
 ``LFM_ZOO_KEEP_GENERATIONS``, ``LFM_FLIGHT``, ``LFM_INCIDENT_DIR``,
-``LFM_INCIDENT_COOLDOWN_S``, ``LFM_ACCESS_LOG``.
+``LFM_INCIDENT_COOLDOWN_S``, ``LFM_ACCESS_LOG``, ``LFM_FLEET`` (+ the
+``LFM_FLEET_*`` routing knobs).
 """
 
 from lfm_quant_tpu.serve.batcher import MicroBatcher, ScoreResponse
+from lfm_quant_tpu.serve.fleet import (
+    FleetCoordinator,
+    FleetRouter,
+    HttpMember,
+    LocalMember,
+    MemberJoinRefused,
+)
 from lfm_quant_tpu.serve.incident import IncidentManager
 from lfm_quant_tpu.serve.persist import ZooStore
 from lfm_quant_tpu.serve.service import ScoringService
 from lfm_quant_tpu.serve.zoo import ModelZoo, ServePrograms, ZooEntry
 
 __all__ = [
+    "FleetCoordinator",
+    "FleetRouter",
+    "HttpMember",
     "IncidentManager",
+    "LocalMember",
+    "MemberJoinRefused",
     "MicroBatcher",
     "ModelZoo",
     "ScoreResponse",

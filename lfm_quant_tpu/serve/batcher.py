@@ -95,6 +95,16 @@ def new_request_id() -> str:
     return uuid.uuid4().hex
 
 
+def backoff_sleep(attempt: int) -> None:
+    """Capped exponential backoff with full jitter, bounded at 50 ms
+    so a retry burst can never stall its caller past a deadline's
+    resolution. ONE owner for the formula: the batcher's dispatch
+    retry and the fleet router's member failover both sleep through
+    here (serve/fleet.py)."""
+    time.sleep(min(0.05, 0.002 * (2 ** (attempt - 1)))
+               * (0.5 + random.random()))
+
+
 def clean_request_id(rid: Optional[str]) -> Optional[str]:
     """Sanitize an INBOUND id (header-sourced — hostile by default):
     keep it opaque but bounded and log-line-safe. None/empty → None
@@ -541,11 +551,7 @@ class MicroBatcher:
                               error=type(e).__name__)
                 with self._stats_lock:
                     self._retry_count += 1
-                # Capped exponential backoff with full jitter: bounded at
-                # 50 ms so a retry burst can never stall the batcher past
-                # a deadline's resolution.
-                time.sleep(min(0.05, 0.002 * (2 ** (attempt - 1)))
-                           * (0.5 + random.random()))
+                backoff_sleep(attempt)
 
     def _dispatch_once(self, universe: str, batch: List[_Request]) -> None:
         # Phase stamps (O(1) per request): first attempt fixes the end

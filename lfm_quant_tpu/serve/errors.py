@@ -88,6 +88,30 @@ class BatcherDeadError(ServeError):
         self.cause = cause
 
 
+class MemberUnavailableError(ServeError):
+    """The fleet router exhausted every candidate member for the
+    universe (``serve/fleet.py``, DESIGN.md §22): each replica was out
+    (dead, open-circuit, unready) or failed its attempt within the
+    bounded member-retry budget. The fleet-level twin of
+    :class:`CircuitOpenError` — fast-fail with a Retry-After covering
+    the member cooldown, after which half-open probes readmit. HTTP
+    503, so a fleet client sees the same taxonomy a single-process
+    client does."""
+
+    http_status = 503
+
+    def __init__(self, universe: str, tried: int,
+                 retry_after_s: float = 0.25):
+        super().__init__(
+            f"no fleet member available for universe {universe!r} "
+            f"(tried {tried} member(s); the rest were out) — "
+            f"fast-failing; retry in {retry_after_s:.3f}s "
+            "(half-open member probes follow)")
+        self.universe = universe
+        self.tried = int(tried)
+        self.retry_after_s = max(0.0, float(retry_after_s))
+
+
 class SnapshotIntegrityError(ServeError):
     """A durable zoo generation failed restore-time verification
     (``serve/persist.py``, DESIGN.md §20): params checksum mismatch,

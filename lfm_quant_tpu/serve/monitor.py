@@ -243,10 +243,13 @@ class ServiceMonitor:
                 METRICS.gauge("score_drift_psi", float(rec["psi"]),
                               universe=universe,
                               generation=rec["generation"])
-        # Fleet identity (ROADMAP item 2 groundwork): WHICH build and
-        # backend produced this scrape — the classic value-1 info gauge
-        # (git sha, jax/jaxlib, backend, resolved dtype, device count,
-        # host), from the cached telemetry.build_info() probe.
+        # Fleet identity (serve/fleet.py, DESIGN.md §22): WHICH build,
+        # backend and MEMBER (host + pid) produced this scrape — the
+        # classic value-1 info gauge, from the cached
+        # telemetry.build_info() probe. The fleet aggregator relabels
+        # each member's scrape with member="name", and host/pid here
+        # let every stat and incident bundle be attributed to the
+        # member process that produced it.
         info = telemetry.build_info()
         METRICS.clear_gauges("build_info")
         METRICS.gauge(
@@ -257,7 +260,8 @@ class ServiceMonitor:
             backend=info.get("backend") or "unknown",
             dtype=info.get("dtype") or "unknown",
             device_count=info.get("device_count") or 0,
-            host=info.get("host") or "unknown")
+            host=info.get("host") or "unknown",
+            pid=info.get("pid") or 0)
         # Incident triggers evaluated at scrape/snapshot time (the
         # signals are windowed aggregates — there is no per-event
         # moment to hook): a burning SLO or a shed-rate spike starts a

@@ -238,18 +238,30 @@ class ZooStore:
                                                # across generations
     """
 
-    def __init__(self, root: str, keep: Optional[int] = None):
+    def __init__(self, root: str, keep: Optional[int] = None,
+                 readonly: bool = False):
         self.root = os.path.abspath(root)
         # Clamped like the env default: keep=0 would make the prune
         # slice `gens[:-0]` silently empty — retention off forever.
         self.keep = (max(1, int(keep)) if keep is not None
                      else keep_generations_default())
+        # Read-only attach (serve/fleet.py, DESIGN.md §22): the store
+        # as a DEPLOY ARTIFACT many fleet members bootstrap from
+        # CONCURRENTLY. The single-writer contract is per store
+        # directory; a read-only attach holds it trivially — no attach
+        # sweep, no journal/tmp mutation, no quarantine renames (N
+        # concurrent readers racing each other's sweeps corrupted
+        # exactly the files they were attaching to), and
+        # record_publish refuses.
+        self.readonly = bool(readonly)
         self.tmp_dir = os.path.join(self.root, "tmp")
         self.journal_path = os.path.join(self.root, "journal.jsonl")
         self.manifest_path = os.path.join(self.root, "manifest.json")
-        os.makedirs(self.tmp_dir, exist_ok=True)
-        os.makedirs(os.path.join(self.root, "universes"), exist_ok=True)
-        os.makedirs(os.path.join(self.root, "execs"), exist_ok=True)
+        if not self.readonly:
+            os.makedirs(self.tmp_dir, exist_ok=True)
+            os.makedirs(os.path.join(self.root, "universes"),
+                        exist_ok=True)
+            os.makedirs(os.path.join(self.root, "execs"), exist_ok=True)
         # The manifest is read-modify-written by every publish;
         # register() and refresh() can run on different threads of one
         # service (the single-WRITER contract is per store directory,
@@ -275,7 +287,8 @@ class ZooStore:
         # aside (that is restore's/publish's LOUD decision — an attach
         # that quarantined would let a subsequent publish commit a
         # fresh manifest that disowns other universes' snapshots).
-        self.sweep(quarantine=False)
+        if not self.readonly:
+            self.sweep(quarantine=False)
 
     # ---- low-level durability primitives -----------------------------
 
@@ -314,7 +327,26 @@ class ZooStore:
 
     def _quarantine(self, path: str, reason: str) -> None:
         """Move a failed artifact aside (never delete — it is the
-        operator's evidence), loudly."""
+        operator's evidence), loudly. A READ-ONLY attach (a fleet
+        member on a shared deploy artifact) reports the verdict with
+        the same counters/warning but renames NOTHING — concurrent
+        readers must not mutate each other's artifact, and the
+        quarantine decision belongs to the store's single writer."""
+        if self.readonly:
+            telemetry.COUNTERS.bump("persist_quarantines")
+            telemetry.instant("restore_quarantine", cat="serve",
+                              path=os.path.relpath(path, self.root),
+                              reason=reason[:200], readonly=True)
+            inc = self.incidents
+            if inc is not None:
+                inc.trigger("quarantine",
+                            path=os.path.relpath(path, self.root),
+                            reason=reason[:200])
+            warnings.warn(
+                f"durable zoo: QUARANTINE verdict (read-only attach, "
+                f"not renamed) {os.path.relpath(path, self.root)}: "
+                f"{reason}", RuntimeWarning, stacklevel=3)
+            return
         dst = f"{path}.quarantined.{int(time.time() * 1e3)}"
         try:
             os.replace(path, dst)
@@ -395,6 +427,11 @@ class ZooStore:
         crash after this commit restores the NEW generation, crash
         before it restores the OLD one; there is no third outcome.
         Returns the generation record written."""
+        if self.readonly:
+            raise RuntimeError(
+                "durable zoo: this store is attached READ-ONLY (a fleet "
+                "member bootstrapping from the deploy artifact) — "
+                "publishes belong to the store's single writer")
         universe, gen = entry.universe, entry.generation
         # ONE commit at a time: the manifest read-modify-write below
         # must not interleave with another thread's (register and
@@ -673,6 +710,12 @@ class ZooStore:
                     ) -> Tuple[Dict[str, int], Optional[Dict[str, Any]]]:
         """Sweep + the manifest it loaded (one parse serves both the
         sweep and the restore that follows it)."""
+        if self.readonly:
+            # A read-only attach sweeps NOTHING (concurrent readers on
+            # one deploy artifact; cleanup belongs to the writer) —
+            # just load the manifest without the quarantine rename.
+            return ({"journal_replays": 0, "orphans": 0},
+                    self.load_manifest(quarantine=False))
         replays = 0
         begun: Dict[Tuple[str, int], str] = {}
         for line in self._read_journal():
@@ -789,17 +832,57 @@ class ZooStore:
                     continue  # the torn final line of a crashed append
         return out
 
+    def probe_record(self, universe: str,
+                     generation: Optional[int] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """The committed parity probe of a universe's generation
+        (newest by default): ``{generation, month, firm_idx, scores}``
+        from the snapshot's ``probe.npz``, or None when absent/
+        unreadable. Read-only — the fleet join gate scores this month
+        through a CANDIDATE member and compares bit-equal (DESIGN.md
+        §22: the §20 publish-time probe IS the promotion criterion,
+        verified actively rather than trusted from a self-report)."""
+        manifest = self.load_manifest(quarantine=False) or {}
+        gens = (manifest.get("universes", {}).get(universe)
+                or {}).get("generations", [])
+        if generation is None:
+            rec = max(gens, key=lambda g: int(g["generation"]),
+                      default=None)
+        else:
+            rec = next((g for g in gens
+                        if int(g["generation"]) == int(generation)),
+                       None)
+        if rec is None:
+            return None
+        try:
+            with np.load(os.path.join(self.root, rec["dir"],
+                                      "probe.npz"),
+                         allow_pickle=False) as z:
+                return {"generation": int(rec["generation"]),
+                        "month": int(z["month"]),
+                        "firm_idx": z["firm_idx"].copy(),
+                        "scores": z["scores"].copy()}
+        except (OSError, KeyError, ValueError):
+            return None
+
     # ---- restore -----------------------------------------------------
 
-    def restore_into(self, service: Any, warm: bool = True
-                     ) -> List[Dict[str, Any]]:
+    def restore_into(self, service: Any, warm: bool = True,
+                     only_newer: bool = False) -> List[Dict[str, Any]]:
         """Re-register every committed universe into ``service``'s zoo,
         newest generation first with older-generation fallback, each
         verified (checksum + bit-exact parity probe) before it may
         serve. Returns one info dict per restored universe; a universe
         whose every committed generation fails verification restores
         NOTHING (loud warning — the fresh-retrain fallback) rather
-        than serving wrong numbers."""
+        than serving wrong numbers.
+
+        ``only_newer`` is the fleet-sync mode (DESIGN.md §22): only
+        generations STRICTLY beyond what the service already serves are
+        considered — the journaled manifest generation is the publish
+        fence a fleet member catches up to; universes already at the
+        fence are silently untouched (the zoo's monotonic-publish
+        invariant stays intact)."""
         t0 = time.perf_counter()
         out: List[Dict[str, Any]] = []
         with telemetry.span("zoo_restore", cat="serve") as sp:
@@ -812,6 +895,15 @@ class ZooStore:
                 return out
             for universe in sorted(manifest.get("universes", {})):
                 gens = manifest["universes"][universe].get("generations", [])
+                if only_newer:
+                    try:
+                        served = int(service.zoo.generation(universe))
+                    except KeyError:
+                        served = -1
+                    gens = [g for g in gens
+                            if int(g["generation"]) > served]
+                    if not gens:
+                        continue  # already at (or past) the fence
                 restored = None
                 for rec in sorted(gens, key=lambda g: -g["generation"]):
                     try:

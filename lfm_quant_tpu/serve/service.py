@@ -51,6 +51,7 @@ class ScoringService:
                  breaker_cooldown_ms: Optional[float] = None,
                  persist_dir: Optional[str] = None,
                  keep_generations: Optional[int] = None,
+                 persist_readonly: bool = False,
                  incident_dir: Optional[str] = None,
                  incident_cooldown_s: Optional[float] = None):
         self.zoo = ModelZoo(zoo_capacity or buckets.zoo_capacity_default())
@@ -85,10 +86,22 @@ class ScoringService:
 
         pd = persist_dir if persist_dir is not None \
             else persist.persist_dir_default()
-        self.store = (persist.ZooStore(pd, keep=keep_generations)
+        # persist_readonly: the fleet-member bootstrap (DESIGN.md §22)
+        # — many members attach ONE deploy-artifact store concurrently,
+        # so the attach must not sweep/journal/quarantine (reads only).
+        self.store = (persist.ZooStore(pd, keep=keep_generations,
+                                       readonly=persist_readonly)
                       if pd else None)
         if self.store is not None:
             self.store.incidents = self.incidents
+        # Store-bootstrap accounting (serve/fleet.py, DESIGN.md §22):
+        # the last restore()/sync_from_store() outcome plus its counted
+        # jit-trace/panel-H2D cost — the join report a fleet
+        # coordinator's promotion gate verifies ("joined at zero
+        # restore compiles" is a measured number, not a claim).
+        self.last_restore: Optional[List[Dict[str, Any]]] = None
+        self.last_restore_compiles: Optional[int] = None
+        self.last_restore_panel_h2d: Optional[int] = None
 
     # ---- registration / warmup --------------------------------------
 
@@ -332,7 +345,46 @@ class ScoringService:
             raise RuntimeError(
                 "restore() needs a durable store — pass persist_dir= or "
                 "set LFM_ZOO_PERSIST to the store directory")
-        return self.store.restore_into(self, warm=warm)
+        from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+        snap = REUSE_COUNTERS.snapshot()
+        out = self.store.restore_into(self, warm=warm)
+        d = REUSE_COUNTERS.delta(snap)
+        self.last_restore = out
+        self.last_restore_compiles = int(d.get("jit_traces", 0))
+        self.last_restore_panel_h2d = int(d.get("panel_transfers", 0))
+        return out
+
+    def sync_from_store(self) -> List[Dict[str, Any]]:
+        """Fleet publish propagation (serve/fleet.py, DESIGN.md §22):
+        pull every generation the durable store has committed BEYOND
+        what this service currently serves — the journaled manifest
+        generation is the fence — through the same verification ladder
+        a restore uses (checksum + parity probe + warm ladder from
+        serialized executables). Universes already at the fence are
+        untouched; returns the newly adopted generations."""
+        if self.store is None:
+            raise RuntimeError(
+                "sync_from_store() needs a durable store — pass "
+                "persist_dir= or set LFM_ZOO_PERSIST")
+        from lfm_quant_tpu.utils.profiling import REUSE_COUNTERS
+
+        snap = REUSE_COUNTERS.snapshot()
+        out = self.store.restore_into(self, warm=True, only_newer=True)
+        d = REUSE_COUNTERS.delta(snap)
+        # Fold the sync into the join-report accounting (same fields
+        # restore() stamps): the generations this member serves and
+        # what they measurably cost must reflect the LATEST pull, or a
+        # behind-fence member that caught up via sync would keep
+        # advertising its stale pre-sync verdicts.
+        self.last_restore = (self.last_restore or []) + out
+        self.last_restore_compiles = (
+            (self.last_restore_compiles or 0) + int(d.get("jit_traces",
+                                                          0)))
+        self.last_restore_panel_h2d = (
+            (self.last_restore_panel_h2d or 0)
+            + int(d.get("panel_transfers", 0)))
+        return out
 
     def restart_batcher(self) -> Dict[str, Any]:
         """In-process recovery for the ``BatcherDeadError`` path
@@ -388,6 +440,14 @@ class ScoringService:
         stats = self.batcher.stats()
         zsnap = self.zoo.snapshot()
         stats["ts"] = ts
+        # Member identity (serve/fleet.py, DESIGN.md §22): WHICH
+        # host/pid produced this snapshot — the fleet aggregation's
+        # attribution key, from the cached telemetry.build_info()
+        # probe (the same identity the lfm_build_info gauge labels and
+        # every incident bundle carry).
+        info = telemetry.build_info()
+        stats["member"] = {"host": info.get("host"),
+                           "pid": info.get("pid")}
         stats["universes"] = zsnap["universes"]
         stats["zoo_size"] = zsnap["size"]
         stats["zoo_capacity"] = zsnap["capacity"]

@@ -487,6 +487,9 @@ _KNOB_PROBES = (
     # Black-box flight recorder (LFM_FLIGHT, DESIGN.md §21): whether
     # the always-on event ring records (the incident-bundle evidence).
     ("flight", "lfm_quant_tpu.utils.flight", "enabled"),
+    # Fleet serving (LFM_FLEET, DESIGN.md §22): whether serve.py runs
+    # N subprocess members behind the failover router.
+    ("fleet", "lfm_quant_tpu.serve.fleet", "fleet_enabled"),
 )
 
 

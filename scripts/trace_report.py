@@ -230,12 +230,27 @@ def load_run(run_dir: str) -> Dict[str, Any]:
                 "metrics_text": prom,
             })
 
+    # The aggregated fleet scrape (serve.py --fleet writes fleet.prom:
+    # router counters + member-labeled member series) — deliberately
+    # NOT matching the metrics*.prom glob above, because its summed
+    # member series answer fleet questions, not the single-process
+    # serve cross-check.
+    fleet_text = None
+    fpath = os.path.join(run_dir, "fleet.prom")
+    if os.path.isfile(fpath):
+        try:
+            with open(fpath) as fh:
+                fleet_text = fh.read()
+        except OSError:
+            fleet_text = None
+
     return {
         "run_dir": run_dir,
         "manifest": manifest,
         "spans": _read_jsonl(os.path.join(run_dir, "spans.jsonl")),
         "ledger": _read_jsonl(os.path.join(run_dir, "ledger.jsonl")),
         "metrics_text": metrics_text,
+        "fleet_text": fleet_text,
         "incidents": incidents,
         # First process owns trace.json; later ones (backtest over a
         # train dir) land as trace.<pid>.json — count them all.
@@ -645,6 +660,100 @@ def build_report(run: Dict[str, Any], top: int = 12) -> Dict[str, Any]:
                               or 0),
             "mismatches": inc_mismatches,
         }
+    # Fleet rollup (serve/fleet.py, DESIGN.md §22): the router's
+    # request/reroute/failover accounting, the join events with their
+    # restore verdicts, and a per-member HEALTH TIMELINE from the
+    # fleet_* instants (joined → out → probe → readmitted) — so "which
+    # member failed, when did the router notice, how long until
+    # readmission" is answerable from the run dir alone. Cross-checked
+    # against the aggregated fleet scrape (fleet.prom) with the same
+    # 1% discipline as the serve/metrics sections: the scrape's
+    # lfm_fleet_*_total lines and the run-record counter deltas come
+    # from ONE process registry, so disagreement means a torn/forged
+    # scrape or a counter bumped outside the run.
+    fleet_events = [s for s in spans
+                    if str(s.get("name", "")).startswith("fleet_")]
+    if fleet_events or counters.get("fleet_requests"):
+        joins = [s.get("args", {}) for s in fleet_events
+                 if s.get("name") == "fleet_member_joined"]
+        refusals = [s.get("args", {}) for s in fleet_events
+                    if s.get("name") == "fleet_member_refused"]
+        timeline: Dict[str, List[Dict[str, Any]]] = {}
+        for s in fleet_events:
+            a = s.get("args", {})
+            member = a.get("member")
+            if not member:
+                continue
+            ev = {"ts": s.get("ts"),
+                  "event": str(s.get("name"))[len("fleet_"):]}
+            for k in ("reason", "error", "universe", "generations"):
+                if a.get(k) is not None:
+                    ev[k] = a[k]
+            timeline.setdefault(member, []).append(ev)
+        for evs in timeline.values():
+            evs.sort(key=lambda e: e.get("ts") or 0.0)
+        fleet_sec: Dict[str, Any] = {
+            "requests": int(counters.get("fleet_requests", 0) or 0),
+            "reroutes": int(counters.get("fleet_reroutes", 0) or 0),
+            "failovers": int(counters.get("fleet_failovers", 0) or 0),
+            "member_outs": int(counters.get("fleet_member_out", 0) or 0),
+            "probes": int(counters.get("fleet_probes", 0) or 0),
+            "readmissions": int(
+                counters.get("fleet_readmissions", 0) or 0),
+            "joins": [{"member": a.get("member"),
+                       "universes": a.get("universes"),
+                       "restore_compiles": a.get("restore_compiles"),
+                       "host": a.get("host"), "pid": a.get("pid")}
+                      for a in joins],
+            "refusals": [{"member": a.get("member"),
+                          "reason": a.get("reason")} for a in refusals],
+            "unroutable": int(counters.get("fleet_unroutable", 0) or 0),
+            "timeline": timeline,
+        }
+        fleet_mismatches: List[str] = []
+        if run.get("fleet_text"):
+            fprom = _parse_prom(run["fleet_text"])
+
+            def _ftotal(name: str) -> Optional[int]:
+                # Router-side counters only: member-labeled series are
+                # the members' OWN registries, not the router's tally.
+                vals = fprom.get(name)
+                if vals is None:
+                    return None
+                return int(sum(v for lab, v in vals
+                               if "member" not in lab))
+
+            # Direction-aware, the §21 lesson: scrape counters are
+            # PROCESS-LIFETIME while the run record holds this run's
+            # deltas, so on a long-lived router the scrape may
+            # legitimately exceed the run — but it can NEVER show
+            # fewer events than the run recorded (same 1% discipline).
+            for key, cname in (("requests", "lfm_fleet_requests_total"),
+                               ("reroutes", "lfm_fleet_reroutes_total"),
+                               ("failovers",
+                                "lfm_fleet_failovers_total"),
+                               ("member_outs",
+                                "lfm_fleet_member_out_total"),
+                               ("readmissions",
+                                "lfm_fleet_readmissions_total")):
+                scraped = _ftotal(cname)
+                spans_v = fleet_sec.get(key)
+                if scraped is None and not spans_v:
+                    continue
+                scraped = scraped or 0
+                tol = max(1.0, 0.01 * abs(spans_v))  # the 1% contract
+                if scraped + tol < spans_v:
+                    fleet_mismatches.append(
+                        f"{key}: fleet scrape total {scraped} is BELOW "
+                        f"the run-record counters {spans_v} (>1% — a "
+                        "lifetime total can never show fewer events "
+                        "than the run recorded; the scrape is torn or "
+                        "forged)")
+            fleet_sec["scrape_members"] = sorted(
+                {lab["member"] for entries in fprom.values()
+                 for lab, _ in entries if "member" in lab})
+        fleet_sec["mismatches"] = fleet_mismatches
+        report["fleet"] = fleet_sec
     # Live-metrics cross-check (the /metrics scrape vs the spans — the
     # pull-side plane and the post-hoc plane must tell the same story):
     # served-request count and degradation totals within 1%, the
@@ -886,6 +995,29 @@ def print_report(rep: Dict[str, Any]) -> None:
                 print(f"    timeline … {tail}")
         for msg in inc.get("mismatches") or []:
             print(f"  INCIDENT MISMATCH: {msg}")
+    fl = rep.get("fleet")
+    if fl:
+        print(f"fleet       : {fl['requests']} routed  "
+              f"reroutes {fl['reroutes']}  failovers {fl['failovers']}  "
+              f"member_outs {fl['member_outs']}  probes {fl['probes']}  "
+              f"readmissions {fl['readmissions']}  "
+              f"unroutable {fl['unroutable']}")
+        for j in fl.get("joins") or []:
+            print(f"  joined    : {j.get('member')} "
+                  f"(host={j.get('host')} pid={j.get('pid')})  "
+                  f"universes={j.get('universes')}  "
+                  f"restore_compiles={j.get('restore_compiles')}")
+        for r in fl.get("refusals") or []:
+            print(f"  REFUSED   : {r.get('member')} — {r.get('reason')}")
+        for member, evs in sorted((fl.get("timeline") or {}).items()):
+            tail = "; ".join(
+                str(e.get("event"))
+                + (f"({e.get('reason') or e.get('error')})"
+                   if e.get("reason") or e.get("error") else "")
+                for e in evs[-6:])
+            print(f"  {member:<10}: {tail}")
+        for msg in fl.get("mismatches") or []:
+            print(f"  FLEET MISMATCH: {msg}")
     mx = rep.get("metrics")
     if mx:
         p99 = mx.get("p99_ms")

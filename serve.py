@@ -63,11 +63,21 @@ VERIFIED (checksum + probe bit-equality; corrupt snapshots are
 quarantined loudly and degrade to fresh retrain), the warm ladder
 rebuilt with zero compiles when the executable artifacts load.
 
+Fleet mode (DESIGN.md §22): ``--fleet N`` (or ``LFM_FLEET=N``)
+publishes the universes to the durable store, spawns N subprocess
+members that each bootstrap from it (read-only attach, verified
+restore, zero compiles), and serves through the health-aware failover
+router — the same front door, one member's death is a reroute. The
+fleet ``/healthz``/``/metrics`` aggregate member snapshots; ``/fleet``
+shows topology + the publish fence; ``/sync`` (on a member) pulls
+newer generations from the store.
+
 Usage:
     python serve.py --universes 3 --requests 200 --run-dir runs/serve
     python serve.py --train-epochs 2 --http 8777
     python serve.py --persist runs/zoo_store --train-epochs 1
     python serve.py --persist runs/zoo_store --restore --requests 100
+    python serve.py --persist runs/zoo_store --fleet 2 --requests 200
 """
 
 from __future__ import annotations
@@ -206,7 +216,8 @@ def drive_load(service, n_requests: int, n_threads: int,
     universes and months. Returns (wall_s, errors, refreshed_gen)."""
     import numpy as np
 
-    universes = service.zoo.universes()
+    universes = (service.universes() if hasattr(service, "universes")
+                 else service.zoo.universes())
     months = {u: service.serveable_months(u) for u in universes}
     done = [0]
     errors = []
@@ -264,6 +275,7 @@ def make_http_server(service, port: int):
 
     from lfm_quant_tpu.serve.batcher import clean_request_id
     from lfm_quant_tpu.serve.errors import ServeError, http_status
+    from lfm_quant_tpu.utils import telemetry
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
@@ -326,6 +338,42 @@ def make_http_server(service, port: int):
                     return self._send_text(
                         200, service.metrics_text(),
                         "text/plain; version=0.0.4; charset=utf-8")
+                if url.path == "/fleet":
+                    # Fleet topology / join report (DESIGN.md §22): a
+                    # router answers with its member registry + fence;
+                    # a member answers with the join report the
+                    # coordinator's promotion gate verifies (identity,
+                    # served generations, restore verdicts, counted
+                    # restore compiles, serveable months).
+                    if hasattr(service, "fleet_info"):
+                        return self._send(200, service.fleet_info())
+                    zsnap = service.zoo.snapshot()
+                    return self._send(200, {
+                        "build": telemetry.build_info(),
+                        "universes": zsnap["universes"],
+                        "months": {u: service.serveable_months(u)
+                                   for u in zsnap["universes"]},
+                        "restore": getattr(service, "last_restore",
+                                           None),
+                        "restore_compiles": getattr(
+                            service, "last_restore_compiles", None),
+                        "restore_panel_h2d": getattr(
+                            service, "last_restore_panel_h2d", None),
+                    })
+                if url.path == "/sync":
+                    # Fleet publish propagation (DESIGN.md §22): pull
+                    # newer-than-served generations from the durable
+                    # store (the journaled manifest generation is the
+                    # fence), verified like a restore.
+                    if getattr(service, "store", None) is None:
+                        return self._send(
+                            404, {"error": "no durable store attached "
+                                           "(LFM_ZOO_PERSIST/--persist)"})
+                    synced = service.sync_from_store()
+                    return self._send(200, {
+                        "synced": synced,
+                        "universes": service.zoo.snapshot()["universes"],
+                    })
                 if url.path == "/score":
                     q = parse_qs(url.query)
                     u, m = q["universe"][0], int(q["month"][0])
@@ -420,10 +468,37 @@ def main(argv=None) -> int:
                          "re-stamped drift references, warm ladder from "
                          "serialized executables (universes that fail "
                          "verification degrade to fresh retrain)")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="fleet mode (DESIGN.md §22; default LFM_FLEET, "
+                         "unset/0 = single process): publish the "
+                         "universes to the durable store, spawn N "
+                         "subprocess members that each bootstrap from "
+                         "it, and serve through the health-aware "
+                         "failover router (requires --persist / "
+                         "LFM_ZOO_PERSIST)")
     args = ap.parse_args(argv)
     if args.restore and not args.persist \
             and os.environ.get("LFM_ZOO_PERSIST", "") in ("", "0"):
         ap.error("--restore needs --persist DIR (or LFM_ZOO_PERSIST)")
+    fleet_n = args.fleet
+    if fleet_n is None:
+        from lfm_quant_tpu.serve.fleet import fleet_members_default
+
+        fleet_n = fleet_members_default()
+    if fleet_n and not args.persist \
+            and os.environ.get("LFM_ZOO_PERSIST", "") in ("", "0"):
+        ap.error("--fleet needs --persist DIR (or LFM_ZOO_PERSIST) — "
+                 "members bootstrap from the durable store")
+    if fleet_n and args.refresh:
+        ap.error("--refresh is not supported with --fleet yet: the "
+                 "mid-stream refresh drives the parent service's zoo, "
+                 "which stops serving once the members take over "
+                 "(fleet publishes propagate via the store fence — "
+                 "see DESIGN.md §22)")
+    if fleet_n:
+        # Reflect CLI-selected fleet mode in the env knob so the run
+        # manifest's `fleet` probe records the mode that actually ran.
+        os.environ["LFM_FLEET"] = str(fleet_n)
 
     from lfm_quant_tpu.serve import ScoringService
     from lfm_quant_tpu.utils import telemetry
@@ -459,37 +534,121 @@ def main(argv=None) -> int:
                 print(f"[serve] registered {name}: gen {entry.generation}, "
                       f"{len(entry.serveable_months())} serveable months, "
                       f"widths {entry.widths()}", flush=True)
-        snap = REUSE_COUNTERS.snapshot()
-        wall, errors, refreshed = drive_load(
-            service, args.requests, args.threads, refresh_mid=args.refresh)
-        d = REUSE_COUNTERS.delta(snap)
-        stats = service.stats()
-        stats.update(
-            wall_s=round(wall, 3),
-            requests_per_sec=round(args.requests / wall, 1) if wall else None,
-            errors=len(errors),
-            refreshed_generation=refreshed,
-            steady_jit_traces=d.get("jit_traces", 0),
-            steady_panel_h2d=d.get("panel_transfers", 0),
-        )
-        print(json.dumps(stats, indent=2, default=str))
-        for e in errors[:5]:
-            print(f"[serve] ERROR {e}", file=sys.stderr)
-        if args.run_dir:
-            # Save the final /metrics scrape beside the spans so
-            # scripts/trace_report.py can cross-check the live metrics
-            # plane against the span-derived numbers (its `metrics`
-            # section — same 1% contract as the stats() twins).
-            with open(os.path.join(args.run_dir, "metrics.prom"),
-                      "w") as fh:
-                fh.write(service.metrics_text())
-            print(f"[serve] telemetry in {args.run_dir} — "
-                  f"python scripts/trace_report.py {args.run_dir}")
+        # Fleet mode (DESIGN.md §22): the registrations above committed
+        # every generation to the durable store; the parent stops
+        # serving, spawns N members that bootstrap from the store, and
+        # becomes the health-aware failover router — the fleet front
+        # door shares this same entry point, error taxonomy and
+        # observability surface with the single-process deploy (which
+        # is exactly the degenerate one-member fleet).
+        front = service
+        router = None
+        fleet_procs = []
+        fleet_tmpdir = None
         try:
+            if fleet_n:
+                import tempfile
+
+                from lfm_quant_tpu.serve import fleet as fleet_mod
+
+                store = service.store
+                service.close()
+                # Ready files + member logs live under the run dir
+                # when there is one (the logs are diagnostic evidence
+                # worth keeping beside the spans); else ONE tempdir,
+                # removed in the finally below — repeated fleet runs
+                # must not accumulate /tmp debris.
+                if args.run_dir:
+                    fleet_dir = os.path.join(args.run_dir, "fleet")
+                    os.makedirs(fleet_dir, exist_ok=True)
+                else:
+                    fleet_dir = tempfile.mkdtemp(prefix="lfm_fleet_")
+                    fleet_tmpdir = fleet_dir
+                specs = []
+                for k in range(fleet_n):
+                    rf = os.path.join(fleet_dir, f"ready_m{k}.json")
+                    # Track the proc the instant it exists: a later
+                    # spawn/join/drive failure must still terminate
+                    # every member in the finally below.
+                    proc = fleet_mod.spawn_member(store.root,
+                                                  ready_file=rf)
+                    fleet_procs.append(proc)
+                    specs.append((proc, rf))
+                coord = fleet_mod.FleetCoordinator(store=store)
+                for k, (proc, rf) in enumerate(specs):
+                    info = fleet_mod.wait_member_ready(proc, rf)
+                    rep = coord.add_member(fleet_mod.HttpMember(
+                        f"m{k}", f"http://127.0.0.1:{info['port']}",
+                        pid=info.get("pid")))
+                    print(f"[serve] fleet member m{k}: pid {info['pid']} "
+                          f"port {info['port']}, restore compiles "
+                          f"{rep.get('restore_compiles')}", flush=True)
+                router = fleet_mod.FleetRouter(coord)
+                front = router
+            snap = REUSE_COUNTERS.snapshot()
+            wall, errors, refreshed = drive_load(
+                front, args.requests, args.threads,
+                refresh_mid=args.refresh)
+            d = REUSE_COUNTERS.delta(snap)
+            stats = front.stats()
+            stats.update(
+                wall_s=round(wall, 3),
+                requests_per_sec=(round(args.requests / wall, 1)
+                                  if wall else None),
+                errors=len(errors),
+                refreshed_generation=refreshed,
+                # Steady-state compile accounting is a PER-PROCESS
+                # measurement: in fleet mode all scoring runs in the
+                # member subprocesses, so the router's counters would
+                # print a vacuous 0/0 — report None (unmeasured here;
+                # each member's scrape carries its own
+                # lfm_jit_traces_total, and the join reports carry the
+                # counted restore compiles).
+                steady_jit_traces=(None if router is not None
+                                   else d.get("jit_traces", 0)),
+                steady_panel_h2d=(None if router is not None
+                                  else d.get("panel_transfers", 0)),
+            )
+            print(json.dumps(stats, indent=2, default=str))
+            for e in errors[:5]:
+                print(f"[serve] ERROR {e}", file=sys.stderr)
+            if args.run_dir:
+                # Save the final /metrics scrape beside the spans so
+                # scripts/trace_report.py can cross-check the live
+                # metrics plane against the span-derived numbers (its
+                # `metrics` section — same 1% contract as the stats()
+                # twins). Fleet runs save the AGGREGATED scrape as
+                # fleet.prom (router counters + member-labeled member
+                # series) for the fleet section's cross-check.
+                if router is not None:
+                    with open(os.path.join(args.run_dir, "fleet.prom"),
+                              "w") as fh:
+                        fh.write(router.metrics_text())
+                else:
+                    with open(os.path.join(args.run_dir,
+                                           "metrics.prom"), "w") as fh:
+                        fh.write(service.metrics_text())
+                print(f"[serve] telemetry in {args.run_dir} — "
+                      f"python scripts/trace_report.py {args.run_dir}")
             if args.http:
-                run_http(service, args.http)
+                run_http(front, args.http)
         finally:
-            service.close()
+            if router is not None:
+                router.close()
+            if fleet_procs:
+                for p in fleet_procs:
+                    p.terminate()
+                for p in fleet_procs:
+                    try:
+                        p.wait(timeout=10)
+                    except Exception:  # noqa: BLE001 — last resort
+                        p.kill()
+            if fleet_tmpdir is not None:
+                import shutil
+
+                shutil.rmtree(fleet_tmpdir, ignore_errors=True)
+            if router is None:
+                service.close()
     return 1 if errors else 0
 
 
